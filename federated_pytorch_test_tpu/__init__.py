@@ -1,0 +1,33 @@
+"""federated_pytorch_test_tpu — a TPU-native federated/consensus optimization framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference
+``koilgg/federated-pytorch-test`` (mounted at /root/reference): K CNN clients
+training on disjoint CIFAR10/100 shards without sharing data, coordinated by
+partial-parameter federated averaging or ADMM consensus (with optional
+Barzilai-Borwein adaptive penalty), driven by a jittable stochastic L-BFGS
+inner optimizer.
+
+Where the reference simulates its three clients sequentially in one process
+(reference src/federated_trio.py:336-338), this framework maps one client per
+TPU device on a `jax.sharding.Mesh` and steps all clients simultaneously
+inside a single `shard_map`ped, jitted training function. The per-partition
+averaging / ADMM z- and y-updates are weighted `psum` collectives over
+ICI/DCN; only the active layer/block partition crosses the interconnect,
+preserving the reference's bandwidth-saving design (reference README.md:2).
+
+Layout:
+  partition/  flat codec + static layer/block partition specs
+  models/     Flax models: Net/Net1/Net2, ResNet18 (ELU) + partition metadata
+  data/       CIFAR pipelines: K-way disjoint shards, biased normalization
+  optim/      jittable stochastic L-BFGS (two-loop recursion + line searches)
+  consensus/  FedAvg / ADMM / adaptive-rho strategies as pure collective fns
+  parallel/   mesh construction, client-axis collectives, sharded step builders
+  ops/        numerics kernels (Pallas where warranted)
+  utils/      config presets, metrics, checkpointing, tracing
+"""
+
+__version__ = "0.1.0"
+
+from federated_pytorch_test_tpu.partition import Partition, Segment
+
+__all__ = ["Partition", "Segment", "__version__"]

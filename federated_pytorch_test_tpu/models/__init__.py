@@ -1,0 +1,33 @@
+"""Flax model zoo with partition metadata.
+
+TPU-native re-design of the reference's models: the three simple CNNs
+(reference src/simple_models.py:9-131) and the inline ResNet18 with ELU
+(reference src/federated_trio_resnet.py:65-152). Inputs are NHWC
+`[batch, 32, 32, 3]` (TPU-friendly layout; the reference is NCHW torch).
+Each model carries static partition metadata — the layer/block grouping,
+the linear-layer ids used for regularization, and the default training
+order — replacing the reference's `linear_layer_ids` /
+`train_order_layer_ids` methods and the hand-written `upidx` block table
+(reference src/federated_trio_resnet.py:174-178).
+"""
+
+from federated_pytorch_test_tpu.models.base import PartitionedModel, init_client_params
+from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
+from federated_pytorch_test_tpu.models.resnet import ResNet18
+
+MODELS = {
+    "net": Net,
+    "net1": Net1,
+    "net2": Net2,
+    "resnet18": ResNet18,
+}
+
+__all__ = [
+    "Net",
+    "Net1",
+    "Net2",
+    "ResNet18",
+    "PartitionedModel",
+    "init_client_params",
+    "MODELS",
+]

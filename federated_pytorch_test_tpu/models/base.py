@@ -1,0 +1,69 @@
+"""Shared model protocol: partition metadata + common-seed client init."""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.partition import Partition, build_partition
+
+PyTree = Any
+
+# Reference init: xavier_uniform on conv/linear weights, bias = 0.01
+# (reference src/federated_trio.py:115-118).
+kernel_init = nn.initializers.xavier_uniform()
+bias_init = nn.initializers.constant(0.01)
+
+
+class PartitionedModel(nn.Module):
+    """A flax module that knows its own layer/block partition.
+
+    Subclasses set three class attrs mirroring the reference's metadata
+    methods (reference src/simple_models.py:29-39):
+
+      GROUP_PATHS:      per-group list of path prefixes into the params tree
+      LINEAR_GROUP_IDS: groups that receive L1/L2 regularization
+      TRAIN_ORDER:      default group visit order per outer loop
+    """
+
+    # NOTE: deliberately un-annotated so linen's dataclass transform treats
+    # them as plain class attributes, not module fields.
+    GROUP_PATHS = ()
+    LINEAR_GROUP_IDS = ()
+    TRAIN_ORDER = ()
+
+    @classmethod
+    def partition(cls, params: PyTree) -> Partition:
+        """Build the static `Partition` for a params tree of this model."""
+        return build_partition(
+            params,
+            cls.GROUP_PATHS,
+            linear_group_ids=cls.LINEAR_GROUP_IDS,
+            train_order=cls.TRAIN_ORDER,
+        )
+
+    @classmethod
+    def input_shape(cls) -> Tuple[int, int, int]:
+        return (32, 32, 3)
+
+
+def init_client_params(model: nn.Module, n_clients: int, seed: int = 0) -> PyTree:
+    """Initialize K identical clients (common-seed init).
+
+    The reference re-seeds before each client's init so all clients start
+    from the same point (reference src/federated_trio.py:229-236). Here we
+    init once and broadcast along a leading `clients` axis; the stacked tree
+    is what gets sharded over the client mesh axis.
+
+    Returns the full variables dict with every leaf shaped `[K, ...]`
+    (including e.g. `batch_stats` collections for BatchNorm models).
+    """
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1,) + tuple(model.input_shape()), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n_clients,) + x.shape), variables
+    )

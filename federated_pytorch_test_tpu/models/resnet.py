@@ -1,0 +1,124 @@
+"""ResNet18 (ELU variant) with the reference's 10-block partition grouping.
+
+Capability parity with the inline ResNet of reference
+src/federated_trio_resnet.py:65-152 (duplicated in
+src/consensus_admm_trio_resnet.py:64-151): BasicBlock with two 3x3 convs +
+BatchNorm, ELU activations everywhere ReLU would normally be, a 1x1-conv
+shortcut when shape changes, 4x4 average pool, and a 10-class linear head.
+
+The reference groups its 62 parameter tensors into 10 communication blocks
+with the hand-written table `upidx=[2,8,14,23,29,38,44,53,59,61]`
+(reference src/federated_trio_resnet.py:174-178). Decoding that table
+against torch's parameter order shows the blocks are exactly structural:
+[stem, layer1.0, layer1.1, layer2.0, layer2.1, layer3.0, layer3.1,
+layer4.0, layer4.1, linear]. Here the grouping is expressed structurally by
+module name, so it cannot drift from the architecture.
+
+BatchNorm batch statistics are a separate `batch_stats` collection, outside
+the partition: they are client-local by design and must never be averaged
+(the reference likewise only communicates `net.parameters()`, which excludes
+running stats; see SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import (
+    PartitionedModel,
+    bias_init,
+    kernel_init,
+)
+
+
+def _conv(features: int, kernel: int, stride: int, name: str) -> nn.Conv:
+    return nn.Conv(
+        features=features,
+        kernel_size=(kernel, kernel),
+        strides=(stride, stride),
+        padding="SAME" if kernel == 3 else "VALID",
+        use_bias=False,
+        name=name,
+        kernel_init=kernel_init,
+    )
+
+
+def _bn(name: str, train: bool) -> nn.BatchNorm:
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-5,
+        name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 conv+BN with ELU and an optional 1x1-conv shortcut.
+
+    Reference src/federated_trio_resnet.py:65-87.
+    """
+
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        in_planes = x.shape[-1]
+        out = nn.elu(_bn("bn1", train)(_conv(self.planes, 3, self.stride, "conv1")(x)))
+        out = _bn("bn2", train)(_conv(self.planes, 3, 1, "conv2")(out))
+        if self.stride != 1 or in_planes != self.planes:
+            x = _bn("sc_bn", train)(_conv(self.planes, 1, self.stride, "sc_conv")(x))
+        return nn.elu(out + x)
+
+
+class ResNet18(PartitionedModel):
+    """ResNet18 for 32x32 inputs, ELU activations, NHWC.
+
+    Reference src/federated_trio_resnet.py:118-152 (`ResNet` + `ResNet18()`).
+    """
+
+    num_classes: int = 10
+
+    # Stage layout [2,2,2,2] with planes 64/128/256/512 and stride 2 at each
+    # stage entry (reference src/federated_trio_resnet.py:124-128,151).
+    STAGES = (  # un-annotated: class attr, not a linen field
+        (64, 1),
+        (64, 1),
+        (128, 2),
+        (128, 1),
+        (256, 2),
+        (256, 1),
+        (512, 2),
+        (512, 1),
+    )
+
+    # 10 communication blocks == the decoded `upidx` table
+    # (reference src/federated_trio_resnet.py:174-178).
+    GROUP_PATHS = (
+        (("conv1",), ("bn1",)),
+        (("block0",),),
+        (("block1",),),
+        (("block2",),),
+        (("block3",),),
+        (("block4",),),
+        (("block5",),),
+        (("block6",),),
+        (("block7",),),
+        (("linear",),),
+    )
+    LINEAR_GROUP_IDS = ()  # resnet drivers apply no L1/L2 in their closures
+    TRAIN_ORDER = tuple(range(10))  # drivers use np.random.permutation at runtime
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = nn.elu(_bn("bn1", train)(_conv(64, 3, 1, "conv1")(x)))
+        for i, (planes, stride) in enumerate(self.STAGES):
+            x = BasicBlock(planes=planes, stride=stride, name=f"block{i}")(
+                x, train=train
+            )
+        x = nn.avg_pool(x, window_shape=(4, 4), strides=(4, 4))  # 4x4 -> 1x1
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(
+            self.num_classes, name="linear", kernel_init=kernel_init, bias_init=bias_init
+        )(x)

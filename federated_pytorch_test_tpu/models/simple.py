@@ -1,0 +1,117 @@
+"""The three simple CNN clients (ELU), NHWC, with partition metadata.
+
+Capability parity with reference src/simple_models.py:9-131 (`Net`, `Net1`,
+`Net2`): same layer shapes, ELU activations, max-pooling, and the same
+layer-numbering universe for the partition metadata — layer g is the
+(kernel, bias) pair of the g-th module in construction order, matching the
+reference's `unfreeze_one_layer` convention of `ci == 2*layer_id`
+(reference src/federated_trio.py:120-126).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import (
+    PartitionedModel,
+    bias_init,
+    kernel_init,
+)
+
+
+def _conv(features: int, kernel: int, padding: str, name: str) -> nn.Conv:
+    return nn.Conv(
+        features=features,
+        kernel_size=(kernel, kernel),
+        padding=padding,
+        name=name,
+        kernel_init=kernel_init,
+        bias_init=bias_init,
+    )
+
+
+def _dense(features: int, name: str) -> nn.Dense:
+    return nn.Dense(
+        features=features, name=name, kernel_init=kernel_init, bias_init=bias_init
+    )
+
+
+def _maxpool(x: jnp.ndarray) -> jnp.ndarray:
+    return nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+
+class Net(PartitionedModel):
+    """LeNet-style 5-layer CNN (~62K params). Reference src/simple_models.py:9-39."""
+
+    GROUP_PATHS = tuple(
+        ((name,),) for name in ("conv1", "conv2", "fc1", "fc2", "fc3")
+    )
+    LINEAR_GROUP_IDS = (2, 3, 4)  # reference src/simple_models.py:29-30
+    TRAIN_ORDER = (2, 0, 1, 3, 4)  # reference src/simple_models.py:38-39
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = _maxpool(nn.elu(_conv(6, 5, "VALID", "conv1")(x)))  # 32->28->14
+        x = _maxpool(nn.elu(_conv(16, 5, "VALID", "conv2")(x)))  # 14->10->5
+        x = x.reshape((x.shape[0], -1))  # 5*5*16 = 400
+        x = nn.elu(_dense(120, "fc1")(x))
+        x = nn.elu(_dense(84, "fc2")(x))
+        return _dense(10, "fc3")(x)
+
+
+class Net1(PartitionedModel):
+    """6-layer CNN (~890K params). Reference src/simple_models.py:44-79."""
+
+    GROUP_PATHS = tuple(
+        ((name,),)
+        for name in ("conv1", "conv2", "conv3", "conv4", "fc1", "fc2")
+    )
+    LINEAR_GROUP_IDS = (4, 5)  # reference src/simple_models.py:69-70
+    TRAIN_ORDER = (2, 5, 1, 3, 0, 4)  # reference src/simple_models.py:78-79
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = nn.elu(_conv(32, 3, "VALID", "conv1")(x))  # 32->30
+        x = nn.elu(_conv(32, 3, "VALID", "conv2")(x))  # 30->28
+        x = _maxpool(x)  # 28->14
+        x = nn.elu(_conv(64, 3, "VALID", "conv3")(x))  # 14->12
+        x = nn.elu(_conv(64, 3, "VALID", "conv4")(x))  # 12->10
+        x = _maxpool(x)  # 10->5
+        x = x.reshape((x.shape[0], -1))  # 5*5*64 = 1600
+        x = nn.elu(_dense(512, "fc1")(x))
+        return _dense(10, "fc2")(x)
+
+
+class Net2(PartitionedModel):
+    """9-layer CNN (~2.5M params). Reference src/simple_models.py:83-131."""
+
+    GROUP_PATHS = tuple(
+        ((name,),)
+        for name in (
+            "conv1",
+            "conv2",
+            "conv3",
+            "conv4",
+            "fc1",
+            "fc2",
+            "fc3",
+            "fc4",
+            "fc5",
+        )
+    )
+    LINEAR_GROUP_IDS = (4, 5, 6, 7, 8)  # reference src/simple_models.py:119-120
+    TRAIN_ORDER = (7, 2, 1, 4, 8, 6, 3, 0, 5)  # reference src/simple_models.py:130-131
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = _maxpool(nn.elu(_conv(64, 3, "SAME", "conv1")(x)))  # 32->16
+        x = _maxpool(nn.elu(_conv(128, 3, "SAME", "conv2")(x)))  # 16->8
+        x = _maxpool(nn.elu(_conv(256, 3, "SAME", "conv3")(x)))  # 8->4
+        x = _maxpool(nn.elu(_conv(512, 3, "SAME", "conv4")(x)))  # 4->2
+        x = x.reshape((x.shape[0], -1))  # 2*2*512 = 2048
+        x = nn.elu(_dense(128, "fc1")(x))
+        x = nn.elu(_dense(256, "fc2")(x))
+        x = nn.elu(_dense(512, "fc3")(x))
+        x = nn.elu(_dense(1024, "fc4")(x))
+        return _dense(10, "fc5")(x)

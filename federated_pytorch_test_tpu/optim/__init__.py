@@ -1,0 +1,21 @@
+"""Inner optimizers.
+
+The centerpiece is a fully jittable stochastic L-BFGS: the TPU-native
+re-design of the reference's closure-based `LBFGSNew`
+(reference src/lbfgsnew.py:9-743). Instead of a stateful torch Optimizer
+that mutates `p.data` between Python-side closure calls, `lbfgs_step` is a
+pure `(loss_fn, x, state) -> (x, state, aux)` transform whose bounded inner
+iteration, two-loop recursion, and line searches all run inside one XLA
+program (`lax.while_loop` / `lax.fori_loop` / `lax.cond`) — so a whole
+optimizer step, including every line-search probe's forward pass, is a
+single fused device computation with no host round-trips.
+"""
+
+from federated_pytorch_test_tpu.optim.lbfgs import (
+    LBFGSConfig,
+    LBFGSState,
+    lbfgs_init,
+    lbfgs_step,
+)
+
+__all__ = ["LBFGSConfig", "LBFGSState", "lbfgs_init", "lbfgs_step"]

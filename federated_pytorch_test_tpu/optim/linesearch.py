@@ -1,0 +1,244 @@
+"""Jittable line searches for the stochastic L-BFGS.
+
+Two strategies, mirroring the reference's pair
+(reference src/lbfgsnew.py:124-174 backtracking, :179-482 cubic/zoom):
+
+* `backtracking_armijo` — stochastic (batch) mode: halve the step from
+  `alphabar` until the Armijo condition holds, at most 35 times.
+* `cubic_linesearch` — full-batch mode: Fletcher bracketing with cubic
+  interpolation and a zoom stage; directional derivatives of the 1-D
+  restriction are taken by central differences of the loss function, as in
+  the reference (src/lbfgsnew.py:209-217), because the restriction's value
+  is all the closure protocol exposes there. All loops are bounded
+  `lax.while_loop`s so every probe's forward pass stays on device.
+
+Deliberate deviation (documented per SURVEY.md §2.2 quirks): the
+reference's `_cubic_interpolate` computes the minimizer `z0` in step units
+but probes the loss at `a + z0*(b-a)` (src/lbfgsnew.py:363-366), mixing
+parameterizations. Here the probe is at `z0` itself — the consistent
+interpretation — which only changes which of {a, b, z0} wins the final
+three-way minimum in rare cases.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Scalar = jnp.ndarray
+PhiFn = Callable[[Scalar], Scalar]  # alpha -> loss(x + alpha * d)
+
+
+def backtracking_armijo(
+    phi: PhiFn,
+    f_old: Scalar,
+    gtd: Scalar,
+    alphabar: Scalar,
+    c1: float = 1e-4,
+    max_iters: int = 35,
+) -> Tuple[Scalar, Scalar]:
+    """Armijo backtracking from max step `alphabar`.
+
+    Reference src/lbfgsnew.py:124-174: start at `alphabar`, halve while
+    `f(x + a d) > f_old + a * c1 * g.d`, up to `max_iters` halvings; the
+    last step is returned even if the condition never held.
+
+    Returns `(alpha, n_evals)`.
+    """
+    prod = c1 * gtd
+
+    def cond(carry):
+        ci, alpha, f_new = carry
+        return jnp.logical_and(ci < max_iters, f_new > f_old + alpha * prod)
+
+    def body(carry):
+        ci, alpha, _ = carry
+        alpha = 0.5 * alpha
+        return ci + 1, alpha, phi(alpha)
+
+    f1 = phi(alphabar)
+    ci, alpha, _ = lax.while_loop(cond, body, (jnp.int32(0), alphabar, f1))
+    return alpha, ci + 1
+
+
+class _CubicConsts(NamedTuple):
+    sigma: float = 0.1
+    rho: float = 0.01
+    t1: float = 9.0
+    t2: float = 0.1
+    t3: float = 0.5
+
+
+def _dphi(phi: PhiFn, a: Scalar, step: float) -> Scalar:
+    """Central-difference directional derivative (reference src/lbfgsnew.py:209-217)."""
+    return (phi(a + step) - phi(a - step)) / (2.0 * step)
+
+
+def _cubic_interpolate(phi: PhiFn, a: Scalar, b: Scalar, step: float) -> Scalar:
+    """Cubic minimizer on [a,b] (or [b,a]); reference src/lbfgsnew.py:306-392."""
+    f0 = phi(a)
+    f0d = _dphi(phi, a, step)
+    f1 = phi(b)
+    f1d = _dphi(phi, b, step)
+
+    aa = 3.0 * (f0 - f1) / (b - a) + f1d - f0d
+    disc = aa * aa - f0d * f1d
+
+    def pos_branch(_):
+        cc = jnp.sqrt(jnp.maximum(disc, 0.0))
+        denom = f1d - f0d + 2.0 * cc
+        z0 = jnp.where(
+            denom == 0.0, (a + b) * 0.5, b - (f1d + cc - aa) * (b - a) / denom
+        )
+        hi = jnp.maximum(a, b)
+        lo = jnp.minimum(a, b)
+        in_range = jnp.logical_and(z0 <= hi, z0 >= lo)
+        # out-of-range probes get f0+f1 so they lose the 3-way minimum
+        fz0 = jnp.where(in_range, phi(jnp.clip(z0, lo, hi)), f0 + f1)
+        best_ab = jnp.where(f1 < fz0, b, z0)
+        return jnp.where(jnp.logical_and(f0 < f1, f0 < fz0), a, best_ab)
+
+    def neg_branch(_):
+        return jnp.where(f0 < f1, a, b)
+
+    return lax.cond(disc > 0.0, pos_branch, neg_branch, operand=None)
+
+
+def _zoom(
+    phi: PhiFn,
+    a: Scalar,
+    b: Scalar,
+    phi_0: Scalar,
+    gphi_0: Scalar,
+    consts: _CubicConsts,
+    step: float,
+    max_iters: int = 4,
+) -> Scalar:
+    """Zoom stage on bracket [a,b]; reference src/lbfgsnew.py:399-482."""
+
+    def cond(carry):
+        ci, _, _, _, found = carry
+        return jnp.logical_and(ci < max_iters, jnp.logical_not(found))
+
+    def body(carry):
+        ci, aj, bj, alphak, _ = carry
+        p01 = aj + consts.t2 * (bj - aj)
+        p02 = bj - consts.t3 * (bj - aj)
+        alphaj = _cubic_interpolate(phi, p01, p02, step)
+        phi_j = phi(alphaj)
+        phi_aj = phi(aj)
+
+        armijo_fail = jnp.logical_or(
+            phi_j > phi_0 + consts.rho * alphaj * gphi_0, phi_j >= phi_aj
+        )
+
+        gphi_j = _dphi(phi, alphaj, step)
+        roundoff = (aj - alphaj) * gphi_j <= step
+        curvature_ok = jnp.abs(gphi_j) <= -consts.sigma * gphi_0
+        found_now = jnp.logical_and(
+            jnp.logical_not(armijo_fail), jnp.logical_or(roundoff, curvature_ok)
+        )
+
+        # bracket updates when not found
+        bj_new = jnp.where(
+            armijo_fail,
+            alphaj,
+            jnp.where(gphi_j * (bj - aj) >= 0.0, aj, bj),
+        )
+        aj_new = jnp.where(armijo_fail, aj, alphaj)
+        return ci + 1, aj_new, bj_new, alphaj, found_now
+
+    _, _, _, alphak, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), a, b, a, jnp.bool_(False))
+    )
+    return alphak
+
+
+def cubic_linesearch(
+    phi: PhiFn,
+    phi_0: Scalar,
+    lr: float,
+    step: float = 1e-6,
+    max_iters: int = 4,
+) -> Scalar:
+    """Strong-Wolfe cubic line search; reference src/lbfgsnew.py:179-303.
+
+    `phi(alpha) = loss(x + alpha * d)`, `phi_0 = phi(0)` (already evaluated).
+    Returns the chosen step size.
+    """
+    consts = _CubicConsts()
+    dt = jnp.asarray(phi_0).dtype
+    tol = jnp.minimum(phi_0 * 0.01, 1e-6)
+    gphi_0 = _dphi(phi, jnp.asarray(0.0, dt), step)
+    mu = (tol - phi_0) / (consts.rho * gphi_0)
+
+    # Outer bracketing loop. Exit codes: 0 = keep looping, 1 = accept alphai,
+    # 2 = zoom(alphai1, alphai), 3 = zoom(alphai, alphai1).
+    def cond(carry):
+        ci, _, _, _, code = carry
+        return jnp.logical_and(ci < max_iters, code == 0)
+
+    def body(carry):
+        ci, alphai, alphai1, phi_prev, _ = carry
+        phi_i = phi(alphai)
+
+        accept0 = phi_i < tol
+        bracket1 = jnp.logical_or(
+            phi_i > phi_0 + alphai * gphi_0,
+            jnp.logical_and(ci > 0, phi_i >= phi_prev),
+        )
+        gphi_i = _dphi(phi, alphai, step)
+        accept2 = jnp.abs(gphi_i) <= -consts.sigma * gphi_0
+        bracket3 = gphi_i >= 0.0
+
+        code = jnp.where(
+            accept0,
+            1,
+            jnp.where(bracket1, 2, jnp.where(accept2, 1, jnp.where(bracket3, 3, 0))),
+        ).astype(jnp.int32)
+
+        # extrapolation step (only meaningful when code==0)
+        take_mu = mu <= 2.0 * alphai - alphai1
+        p01 = 2.0 * alphai - alphai1
+        p02 = jnp.minimum(mu, alphai + consts.t1 * (alphai - alphai1))
+        alphai_interp = _cubic_interpolate(phi, p01, p02, step)
+        alphai_next = jnp.where(take_mu, mu, alphai_interp)
+        alphai1_next = jnp.where(take_mu, alphai, alphai1)
+
+        keep = code == 0
+        return (
+            ci + 1,
+            jnp.where(keep, alphai_next, alphai),
+            jnp.where(keep, alphai1_next, alphai1),
+            jnp.where(keep, phi_i, phi_prev),
+            code,
+        )
+
+    alpha1 = jnp.asarray(10.0 * lr, dt)
+    ci, alphai, alphai1, _, code = lax.while_loop(
+        cond,
+        body,
+        (jnp.int32(0), alpha1, jnp.asarray(0.0, dt), phi_0, jnp.int32(0)),
+    )
+
+    def do_zoom(bracket):
+        a, b = bracket
+        return _zoom(phi, a, b, phi_0, gphi_0, consts, step)
+
+    alphak = lax.switch(
+        jnp.clip(code, 0, 3),
+        [
+            lambda _: jnp.asarray(lr, dt),  # loop exhausted: fall back to lr
+            lambda _: alphai,  # accepted directly
+            lambda _: do_zoom((alphai1, alphai)),
+            lambda _: do_zoom((alphai, alphai1)),
+        ],
+        operand=None,
+    )
+
+    # degenerate cases: flat direction or non-finite mu -> step 1.0
+    degenerate = jnp.logical_or(jnp.abs(gphi_0) < 1e-12, jnp.isnan(mu))
+    return jnp.where(degenerate, jnp.asarray(1.0, dt), alphak)

@@ -1,0 +1,23 @@
+"""Parameter-partition machinery.
+
+TPU-native replacement for the reference's freeze/flat-vector machinery
+(reference src/federated_trio.py:120-196 `unfreeze_one_layer`,
+`get_trainable_values`, `put_trainable_values`; block-range variant
+src/federated_trio_resnet.py:189-243). Instead of mutating `requires_grad`
+flags on a stateful module, a `Partition` is a static description of how the
+raveled parameter vector decomposes into layer/block groups; extracting and
+inserting a group's flat vector are pure, jit-compatible functions with
+static shapes, so XLA sees fixed-size slices and the consensus collectives
+only ever move the active group's coordinates.
+"""
+
+from federated_pytorch_test_tpu.partition.flat import flatten_params, unflatten_like
+from federated_pytorch_test_tpu.partition.spec import Partition, Segment, build_partition
+
+__all__ = [
+    "Partition",
+    "Segment",
+    "build_partition",
+    "flatten_params",
+    "unflatten_like",
+]

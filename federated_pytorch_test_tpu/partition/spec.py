@@ -1,0 +1,152 @@
+"""Static partition specs over the raveled parameter vector.
+
+The reference trains one "layer" (weight+bias pair, reference
+src/federated_trio.py:120-126) or one ResNet block-range (reference
+src/federated_trio_resnet.py:189-203, `upidx` table :174-178) per outer
+round, and only that group's parameters are averaged. Here a `Partition`
+captures that grouping statically: each group is a tuple of `(start, size)`
+segments into the flat vector. `extract`/`insert` are pure functions with
+shapes fixed at trace time, so each group's training round compiles to a
+fixed-size program and the consensus collectives move exactly
+`group_size(gid)` floats across the mesh — the bandwidth-saving contract of
+reference README.md:2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.partition.flat import leaf_offsets, total_size
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """A contiguous [start, start+size) span of the flat parameter vector."""
+
+    start: int
+    size: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """A static decomposition of the flat parameter vector into groups.
+
+    Attributes:
+      groups: per-group tuple of `Segment`s (merged / contiguous where
+        possible). Group ids follow the model's layer numbering, matching
+        the reference's `train_order_layer_ids` universe
+        (reference src/simple_models.py:38-39,78-79,130-131).
+      total: length of the full flat vector.
+      linear_group_ids: groups carrying L1/L2 regularization (the
+        reference's `linear_layer_ids`, src/simple_models.py:29-30).
+      train_order: default group visit order per outer loop.
+    """
+
+    groups: Tuple[Tuple[Segment, ...], ...]
+    total: int
+    linear_group_ids: Tuple[int, ...] = ()
+    train_order: Tuple[int, ...] = ()
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    def group_size(self, gid: int) -> int:
+        return sum(s.size for s in self.groups[gid])
+
+    def extract(self, flat: jnp.ndarray, gid: int) -> jnp.ndarray:
+        """Pure function: flat vector -> the group's coordinates (static shape)."""
+        segs = self.groups[gid]
+        parts = [jax.lax.slice(flat, (s.start,), (s.start + s.size,)) for s in segs]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def insert(self, flat: jnp.ndarray, gid: int, vec: jnp.ndarray) -> jnp.ndarray:
+        """Pure function: write the group's coordinates back into the flat vector."""
+        segs = self.groups[gid]
+        off = 0
+        for s in segs:
+            flat = jax.lax.dynamic_update_slice(
+                flat, jax.lax.slice(vec, (off,), (off + s.size,)), (s.start,)
+            )
+            off += s.size
+        return flat
+
+    def mask(self, gid: int) -> jnp.ndarray:
+        """Boolean mask over the flat vector for one group (diagnostics)."""
+        m = jnp.zeros((self.total,), dtype=bool)
+        for s in self.groups[gid]:
+            m = m.at[s.start : s.start + s.size].set(True)
+        return m
+
+    def validate(self) -> None:
+        """Check groups tile the flat vector exactly once (no overlap, no gap)."""
+        spans = sorted(
+            (s.start, s.size) for segs in self.groups for s in segs
+        )
+        cursor = 0
+        for start, size in spans:
+            if start != cursor:
+                raise ValueError(
+                    f"partition groups do not tile flat vector: gap/overlap at {start} (expected {cursor})"
+                )
+            cursor += size
+        if cursor != self.total:
+            raise ValueError(f"partition covers {cursor} of {self.total} parameters")
+
+
+def _merge_segments(spans: Sequence[Tuple[int, int]]) -> Tuple[Segment, ...]:
+    """Merge sorted (start, size) spans into maximal contiguous segments."""
+    merged = []
+    for start, size in sorted(spans):
+        if merged and merged[-1][0] + merged[-1][1] == start:
+            merged[-1][1] += size
+        else:
+            merged.append([start, size])
+    return tuple(Segment(s, n) for s, n in merged)
+
+
+def build_partition(
+    template: PyTree,
+    group_paths: Sequence[Sequence[Tuple[str, ...]]],
+    linear_group_ids: Sequence[int] = (),
+    train_order: Sequence[int] = (),
+) -> Partition:
+    """Build a `Partition` from a params template and per-group path prefixes.
+
+    `group_paths[g]` is a list of path prefixes (tuples of string keys);
+    every leaf whose path starts with one of them belongs to group `g`.
+    Every leaf must belong to exactly one group.
+    """
+    offsets = leaf_offsets(template)
+    groups = []
+    claimed: dict[Tuple[str, ...], int] = {}
+    for g, prefixes in enumerate(group_paths):
+        spans = []
+        for path, start, size in offsets:
+            if any(path[: len(p)] == tuple(p) for p in prefixes):
+                if path in claimed:
+                    raise ValueError(
+                        f"leaf {path} claimed by groups {claimed[path]} and {g}"
+                    )
+                claimed[path] = g
+                spans.append((start, size))
+        if not spans:
+            raise ValueError(f"group {g} with prefixes {prefixes} matched no leaves")
+        groups.append(_merge_segments(spans))
+    unclaimed = [path for path, _, _ in offsets if path not in claimed]
+    if unclaimed:
+        raise ValueError(f"leaves not claimed by any group: {unclaimed}")
+    part = Partition(
+        groups=tuple(groups),
+        total=total_size(template),
+        linear_group_ids=tuple(linear_group_ids),
+        train_order=tuple(train_order) if train_order else tuple(range(len(groups))),
+    )
+    part.validate()
+    return part

@@ -1,0 +1,30 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; the client mesh axis is
+exercised on XLA's host platform with 8 virtual devices instead (the
+TPU-native analogue of the reference's in-process three-client simulation;
+see SURVEY.md §4).
+
+The ambient environment registers a real-TPU PJRT plugin ("axon") via
+sitecustomize at interpreter start and pins jax to it; initializing that
+backend dials a tunnel and blocks forever from inside the test runner. The
+plugin factory is therefore dropped before any backend is instantiated and
+the platform is forced back to cpu. This must run before any test module
+imports jax numerics, hence it lives at conftest import time.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,175 @@
+"""Unit tests for the jittable stochastic L-BFGS.
+
+Strategy per SURVEY.md §4: validate the core numerics on analytic problems
+(quadratics with known minimizers, Rosenbrock), the stochastic machinery on
+a minibatched least-squares problem, and the NaN guards that the reference
+carries (reference src/lbfgsnew.py:542,679-681).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.optim import (
+    LBFGSConfig,
+    lbfgs_init,
+    lbfgs_step,
+)
+
+
+def _quadratic(n=12, seed=0):
+    rng = np.random.RandomState(seed)
+    m = rng.randn(n, n)
+    a = m @ m.T + n * np.eye(n)
+    b = rng.randn(n)
+    x_star = np.linalg.solve(a, b)
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    def loss(x):
+        return 0.5 * x @ (a @ x) - b @ x
+
+    return loss, jnp.asarray(x_star, jnp.float32)
+
+
+def test_quadratic_converges_fullbatch_linesearch():
+    loss, x_star = _quadratic()
+    cfg = LBFGSConfig(max_iter=30, history_size=7, line_search=True)
+    x = jnp.zeros_like(x_star)
+    state = lbfgs_init(x, cfg)
+    for _ in range(3):
+        x, state, aux = lbfgs_step(loss, x, state, cfg)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(x_star), atol=1e-2)
+
+
+def test_quadratic_converges_fixed_step():
+    # no line search: relies on the 1/sum|g| step seed + curvature updates
+    loss, x_star = _quadratic(n=6, seed=1)
+    cfg = LBFGSConfig(lr=0.05, max_iter=80, history_size=7, line_search=False)
+    x = jnp.zeros_like(x_star)
+    state = lbfgs_init(x, cfg)
+    for _ in range(5):
+        x, state, aux = lbfgs_step(loss, x, state, cfg)
+    assert float(loss(x)) < float(loss(jnp.zeros_like(x))) - 0.5 * abs(
+        float(loss(x_star))
+    ) or float(jnp.linalg.norm(x - x_star)) < 0.1
+
+
+def test_rosenbrock_descends():
+    def loss(x):
+        return (1.0 - x[0]) ** 2 + 100.0 * (x[1] - x[0] ** 2) ** 2
+
+    cfg = LBFGSConfig(max_iter=40, history_size=10, line_search=True)
+    x = jnp.asarray([-1.2, 1.0], jnp.float32)
+    state = lbfgs_init(x, cfg)
+    for _ in range(6):
+        x, state, aux = lbfgs_step(loss, x, state, cfg)
+    assert float(loss(x)) < 1e-2
+    np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=0.2)
+
+
+def test_history_accumulates_and_caps():
+    loss, _ = _quadratic(n=8, seed=2)
+    cfg = LBFGSConfig(max_iter=4, history_size=3, line_search=True)
+    x = jnp.ones((8,), jnp.float32)
+    state = lbfgs_init(x, cfg)
+    x, state, _ = lbfgs_step(loss, x, state, cfg)
+    assert int(state.hist_count) <= 3
+    for _ in range(4):
+        x, state, _ = lbfgs_step(loss, x, state, cfg)
+    assert int(state.hist_count) <= 3
+    assert int(state.n_iter) >= 4
+
+
+def test_batch_mode_least_squares_descends():
+    # K minibatches of a linear regression; one lbfgs_step per batch, as in
+    # the reference training loops (reference src/federated_trio.py:304-338).
+    rng = np.random.RandomState(3)
+    w_true = rng.randn(16).astype(np.float32)
+    feats = rng.randn(40, 16).astype(np.float32)
+    targets = feats @ w_true + 0.01 * rng.randn(40).astype(np.float32)
+    batches = [
+        (jnp.asarray(feats[i : i + 8]), jnp.asarray(targets[i : i + 8]))
+        for i in range(0, 40, 8)
+    ]
+
+    cfg = LBFGSConfig(
+        max_iter=4, history_size=10, line_search=True, batch_mode=True
+    )
+    x = jnp.zeros((16,), jnp.float32)
+    state = lbfgs_init(x, cfg)
+
+    def make_loss(bf, bt):
+        return lambda w: jnp.mean((bf @ w - bt) ** 2)
+
+    full = make_loss(jnp.asarray(feats), jnp.asarray(targets))
+    loss_before = float(full(x))
+    for epoch in range(3):
+        for bf, bt in batches:
+            x, state, aux = lbfgs_step(make_loss(bf, bt), x, state, cfg)
+    loss_after = float(full(x))
+    assert loss_after < 0.1 * loss_before
+    assert np.isfinite(np.asarray(x)).all()
+    # running inter-batch statistics were populated
+    assert float(jnp.sum(jnp.abs(state.running_avg))) > 0.0
+
+
+def test_step_is_jittable_and_pure():
+    loss, _ = _quadratic(n=5, seed=4)
+    cfg = LBFGSConfig(max_iter=6, history_size=4, line_search=True)
+    x = jnp.ones((5,), jnp.float32)
+    state = lbfgs_init(x, cfg)
+
+    stepped = jax.jit(lambda xx, ss: lbfgs_step(loss, xx, ss, cfg))
+    x1, s1, a1 = stepped(x, state)
+    x2, s2, a2 = stepped(x, state)
+    np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+    np.testing.assert_array_equal(np.asarray(s1.d), np.asarray(s2.d))
+
+
+def test_nan_gradient_leaves_params_unchanged():
+    # reference src/lbfgsnew.py:541-542: a NaN gradient norm at entry skips
+    # the whole optimization loop.
+    def loss(x):
+        return jnp.sum(x) * jnp.nan
+
+    cfg = LBFGSConfig(max_iter=4, line_search=True)
+    x = jnp.ones((3,), jnp.float32)
+    state = lbfgs_init(x, cfg)
+    x1, state1, aux = lbfgs_step(loss, x, state, cfg)
+    np.testing.assert_array_equal(np.asarray(x1), np.asarray(x))
+    assert int(aux.n_inner) == 0
+
+
+def test_float64_dtype_generic():
+    # dtype genericity: the optimizer must work under jax_enable_x64
+    # (float64 problems), not just the f32 default.
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.RandomState(7)
+        m = rng.randn(6, 6)
+        a = jnp.asarray(m @ m.T + 6 * np.eye(6), jnp.float64)
+        b = jnp.asarray(rng.randn(6), jnp.float64)
+
+        def loss(x):
+            return 0.5 * x @ (a @ x) - b @ x
+
+        cfg = LBFGSConfig(max_iter=20, history_size=5, line_search=True)
+        x = jnp.zeros((6,), jnp.float64)
+        state = lbfgs_init(x, cfg)
+        for _ in range(2):
+            x, state, aux = lbfgs_step(loss, x, state, cfg)
+        assert x.dtype == jnp.float64
+        x_star = np.linalg.solve(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(x), x_star, atol=1e-5)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_zero_gradient_early_exit():
+    loss, x_star = _quadratic(n=4, seed=5)
+    cfg = LBFGSConfig(max_iter=4, line_search=True)
+    state = lbfgs_init(x_star, cfg)
+    x1, state1, aux = lbfgs_step(loss, x_star, state, cfg)
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x_star), atol=1e-4)

@@ -1,0 +1,133 @@
+"""Unit tests for the flat codec and partition specs.
+
+Covers the capability contract of the reference's freeze/flat machinery
+(reference src/federated_trio.py:120-196): extract/insert round trips,
+exact tiling of the parameter space, and group sizes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.models import Net, Net1, Net2, ResNet18
+from federated_pytorch_test_tpu.partition import (
+    Partition,
+    Segment,
+    build_partition,
+    flatten_params,
+)
+from federated_pytorch_test_tpu.partition.flat import leaf_offsets, total_size
+
+
+def _init(model):
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    return model.init(rng, x, train=False)
+
+
+@pytest.fixture(scope="module")
+def net_params():
+    return _init(Net())["params"]
+
+
+def test_flatten_round_trip(net_params):
+    flat, unravel = flatten_params(net_params)
+    assert flat.ndim == 1
+    restored = unravel(flat)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(net_params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_leaf_offsets_cover_everything(net_params):
+    offs = leaf_offsets(net_params)
+    assert offs[0][1] == 0
+    sizes = sum(o[2] for o in offs)
+    assert sizes == total_size(net_params)
+
+
+@pytest.mark.parametrize("model_cls", [Net, Net1, Net2])
+def test_simple_model_partitions_tile(model_cls):
+    params = _init(model_cls())["params"]
+    part = model_cls.partition(params)
+    part.validate()
+    assert part.num_groups == len(model_cls.GROUP_PATHS)
+    assert sorted(part.train_order) == list(range(part.num_groups))
+    flat, _ = flatten_params(params)
+    assert sum(part.group_size(g) for g in range(part.num_groups)) == flat.shape[0]
+
+
+def test_net_group_sizes_match_reference_shapes():
+    # Layer param counts from reference src/simple_models.py:9-17:
+    # conv1 3->6 5x5 (+bias), conv2 6->16 5x5, fc1 400->120, fc2 120->84, fc3 84->10.
+    params = _init(Net())["params"]
+    part = Net.partition(params)
+    expected = [
+        5 * 5 * 3 * 6 + 6,
+        5 * 5 * 6 * 16 + 16,
+        400 * 120 + 120,
+        120 * 84 + 84,
+        84 * 10 + 10,
+    ]
+    assert [part.group_size(g) for g in range(5)] == expected
+
+
+def test_extract_insert_round_trip(net_params):
+    part = Net.partition(net_params)
+    flat, _ = flatten_params(net_params)
+    for g in range(part.num_groups):
+        vec = part.extract(flat, g)
+        assert vec.shape == (part.group_size(g),)
+        flat2 = part.insert(flat, g, jnp.zeros_like(vec))
+        # the group is zeroed, everything else untouched
+        mask = np.asarray(part.mask(g))
+        np.testing.assert_array_equal(np.asarray(flat2)[mask], 0.0)
+        np.testing.assert_array_equal(np.asarray(flat2)[~mask], np.asarray(flat)[~mask])
+        # and re-inserting the extracted values restores the original
+        flat3 = part.insert(flat2, g, vec)
+        np.testing.assert_array_equal(np.asarray(flat3), np.asarray(flat))
+
+
+def test_extract_insert_jit_compatible(net_params):
+    part = Net.partition(net_params)
+    flat, _ = flatten_params(net_params)
+
+    @jax.jit
+    def roundtrip(f):
+        v = part.extract(f, 2)
+        return part.insert(f, 2, v * 2.0)
+
+    out = roundtrip(flat)
+    mask = np.asarray(part.mask(2))
+    np.testing.assert_allclose(np.asarray(out)[mask], 2 * np.asarray(flat)[mask], rtol=1e-6)
+
+
+def test_resnet18_partition_has_ten_blocks():
+    variables = _init(ResNet18())
+    part = ResNet18.partition(variables["params"])
+    assert part.num_groups == 10
+    part.validate()
+    # linear head: 512*10 + 10 params (reference src/federated_trio_resnet.py:130)
+    assert part.group_size(9) == 512 * 10 + 10
+    # stem: 3x3x3x64 conv + bn scale/bias (reference :124-125)
+    assert part.group_size(0) == 3 * 3 * 3 * 64 + 64 + 64
+
+
+def test_resnet18_total_param_count_matches_torch_resnet18():
+    # Torch CIFAR ResNet18 (reference src/federated_trio_resnet.py:151)
+    # has 11,173,962 trainable params.
+    variables = _init(ResNet18())
+    assert total_size(variables["params"]) == 11_173_962
+
+
+def test_bad_partition_rejected():
+    tpl = {"a": jnp.zeros((4,)), "b": jnp.zeros((4,))}
+    with pytest.raises(ValueError):
+        build_partition(tpl, [ (("a",),) ])  # leaves 'b' unclaimed
+    with pytest.raises(ValueError):
+        build_partition(tpl, [ (("a",),), (("a",), ("b",)) ])  # 'a' claimed twice
+    part = Partition(groups=((Segment(0, 4),), (Segment(5, 3),)), total=8)
+    with pytest.raises(ValueError):
+        part.validate()  # gap at 4
