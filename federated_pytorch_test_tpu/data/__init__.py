@@ -1,0 +1,45 @@
+"""Data pipelines: CIFAR sources, K-way disjoint shards, biased normalization.
+
+Capability parity with the reference's per-driver data setup (reference
+src/no_consensus_trio.py:27-82, duplicated in every driver): CIFAR10 split
+into K disjoint contiguous shards, optional per-client "biased"
+normalization, shuffled per-epoch batches consumed in lockstep across
+clients.
+
+TPU-first design: the host pipeline hands out stacked `[K, batch, ...]`
+uint8 arrays laid out for the client mesh axis; the `/255` + per-client
+mean/std normalization is a jittable function applied on device (uint8
+crosses PCIe, float32 never does). The reference instead bakes
+normalization into torchvision transforms on the host
+(reference src/no_consensus_trio.py:34-50).
+"""
+
+from federated_pytorch_test_tpu.data.cifar import (
+    DataSource,
+    load_cifar,
+    load_cifar10,
+    load_cifar100,
+    synthetic_cifar,
+)
+from federated_pytorch_test_tpu.data.pipeline import (
+    BIASED_STATS,
+    FederatedDataset,
+    client_splits,
+    client_stats,
+    make_federated,
+    normalize,
+)
+
+__all__ = [
+    "BIASED_STATS",
+    "DataSource",
+    "FederatedDataset",
+    "client_splits",
+    "client_stats",
+    "load_cifar",
+    "load_cifar10",
+    "load_cifar100",
+    "make_federated",
+    "normalize",
+    "synthetic_cifar",
+]

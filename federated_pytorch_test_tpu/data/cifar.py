@@ -1,0 +1,144 @@
+"""CIFAR dataset sources: on-disk loaders + deterministic synthetic fallback.
+
+The reference downloads CIFAR10 through torchvision (reference
+src/no_consensus_trio.py:52-57). This environment has no network egress and
+no torchvision, so the equivalent capability is provided two ways:
+
+* `load_cifar10` / `load_cifar100` read the standard published archive
+  layouts (python-pickle batches or the binary ``*.bin`` format) from a
+  local directory, producing identical uint8 HWC arrays to torchvision's
+  in-memory representation.
+* `synthetic_cifar` generates a deterministic, *learnable*
+  class-conditional dataset with the same shapes/dtypes, used by tests and
+  benchmarks when no real archive is present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import tarfile
+import warnings
+from typing import Tuple
+
+import numpy as np
+
+
+class ArchiveNotFound(FileNotFoundError):
+    """No dataset archive present at the given root (distinct from a
+    present-but-corrupt archive, which must not silently fall back)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSource:
+    """An image-classification dataset in canonical uint8 NHWC layout."""
+
+    train_images: np.ndarray  # [N, 32, 32, 3] uint8
+    train_labels: np.ndarray  # [N] int32
+    test_images: np.ndarray  # [M, 32, 32, 3] uint8
+    test_labels: np.ndarray  # [M] int32
+    num_classes: int
+    name: str = "cifar10"
+
+    def __post_init__(self):
+        assert self.train_images.dtype == np.uint8
+        assert self.train_images.shape[1:] == (32, 32, 3)
+
+
+def _planes_to_hwc(flat: np.ndarray) -> np.ndarray:
+    """CIFAR stores 3072 bytes as R/G/B planes; convert to HWC uint8."""
+    return flat.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+
+
+def _load_pickle_batches(root: str, files, label_key: bytes):
+    images, labels = [], []
+    for fn in files:
+        with open(os.path.join(root, fn), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        images.append(_planes_to_hwc(np.asarray(d[b"data"], np.uint8)))
+        labels.append(np.asarray(d[label_key], np.int32))
+    return np.concatenate(images), np.concatenate(labels)
+
+
+def load_cifar10(root: str) -> DataSource:
+    """Load CIFAR-10 from `root` (accepts the dir containing, or equal to,
+    ``cifar-10-batches-py``; a ``cifar-10-python.tar.gz`` is unpacked)."""
+    root = _resolve(root, "cifar-10-batches-py", "cifar-10-python.tar.gz")
+    tr_i, tr_l = _load_pickle_batches(
+        root, [f"data_batch_{i}" for i in range(1, 6)], b"labels"
+    )
+    te_i, te_l = _load_pickle_batches(root, ["test_batch"], b"labels")
+    return DataSource(tr_i, tr_l, te_i, te_l, 10, "cifar10")
+
+
+def load_cifar100(root: str) -> DataSource:
+    root = _resolve(root, "cifar-100-python", "cifar-100-python.tar.gz")
+    tr_i, tr_l = _load_pickle_batches(root, ["train"], b"fine_labels")
+    te_i, te_l = _load_pickle_batches(root, ["test"], b"fine_labels")
+    return DataSource(tr_i, tr_l, te_i, te_l, 100, "cifar100")
+
+
+def _resolve(root: str, subdir: str, tarball: str) -> str:
+    if os.path.basename(os.path.normpath(root)) == subdir:
+        return root
+    cand = os.path.join(root, subdir)
+    if os.path.isdir(cand):
+        return cand
+    tb = os.path.join(root, tarball)
+    if os.path.isfile(tb):
+        with tarfile.open(tb) as t:
+            t.extractall(root, filter="data")
+        return cand
+    raise ArchiveNotFound(f"no {subdir} under {root}")
+
+
+def synthetic_cifar(
+    n_train: int = 50_000,
+    n_test: int = 10_000,
+    num_classes: int = 10,
+    seed: int = 0,
+) -> DataSource:
+    """Deterministic learnable stand-in with CIFAR shapes.
+
+    Each class c gets a fixed low-frequency prototype image; samples are
+    `clip(prototype + noise)`. A small CNN separates the classes well above
+    chance within one epoch, so convergence smoke tests (SURVEY.md §4d)
+    remain meaningful without the real archive.
+    """
+    rng = np.random.default_rng(seed)
+    # low-frequency prototypes: upsampled 4x4 color patterns
+    proto_small = rng.uniform(60, 195, size=(num_classes, 4, 4, 3))
+    proto = proto_small.repeat(8, axis=1).repeat(8, axis=2)  # [C,32,32,3]
+
+    def draw(n: int, r: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+        labels = r.integers(0, num_classes, size=n).astype(np.int32)
+        noise = r.normal(0.0, 35.0, size=(n, 32, 32, 3))
+        images = np.clip(proto[labels] + noise, 0, 255).astype(np.uint8)
+        return images, labels
+
+    tr_i, tr_l = draw(n_train, rng)
+    te_i, te_l = draw(n_test, rng)
+    return DataSource(tr_i, tr_l, te_i, te_l, num_classes, "synthetic")
+
+
+def load_cifar(
+    name: str = "cifar10", root: str | None = None, synthetic_ok: bool = True
+) -> DataSource:
+    """Load `name` from `root` (or $CIFAR_DATA_DIR), falling back to the
+    synthetic source only when NO archive is present at all. A present but
+    corrupt/partial archive raises — it must not silently train on
+    synthetic data."""
+    root = root or os.environ.get("CIFAR_DATA_DIR", "./torchdata")
+    loader = {"cifar10": load_cifar10, "cifar100": load_cifar100}[name]
+    try:
+        return loader(root)
+    except ArchiveNotFound:
+        if not synthetic_ok:
+            raise
+        warnings.warn(
+            f"no {name} archive under {root}; using the deterministic "
+            "synthetic stand-in dataset",
+            stacklevel=2,
+        )
+        return synthetic_cifar(num_classes=10 if name == "cifar10" else 100)

@@ -1,0 +1,168 @@
+"""K-client sharding, biased normalization constants, lockstep batching.
+
+Reference behavior being matched (src/no_consensus_trio.py:27-82):
+
+* the train set is split into K disjoint *contiguous* index ranges
+  (`subset1=range(0,16666)`, ... :28-30) — `client_splits` reproduces the
+  same floor-split boundaries for any (n, K);
+* with `biased_input`, clients normalize with different (mean, std):
+  (.5,.5), (.3,.4), (.6,.5) (:34-45) — extended to K>3 by cycling;
+* each client draws shuffled batches from its own shard
+  (`SubsetRandomSampler`, :59-61) and the drivers consume one batch per
+  client per global step via `zip(trainloader1, ...)`
+  (reference src/federated_trio.py:285) — here a single iterator yields the
+  already-stacked `[K, B, ...]` arrays that land sharded on the client mesh
+  axis;
+* every client evaluates on the full test set under its own normalization
+  (:65-75).
+
+Deliberate deviation (documented per SURVEY.md §2.2 guidance): batches have
+static shapes for XLA, so each epoch yields `min_k(n_k) // B` full batches
+and drops the ragged tail; torch's DataLoader default would emit one final
+partial batch. At CIFAR scale this drops <0.4% of samples per epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from federated_pytorch_test_tpu.data.cifar import DataSource
+
+# Per-client (mean, std), cycled for K>3. Reference
+# src/no_consensus_trio.py:34-45 (channels share one value).
+BIASED_STATS = ((0.5, 0.5), (0.3, 0.4), (0.6, 0.5))
+UNBIASED_STAT = (0.5, 0.5)
+
+
+def client_splits(n: int, k: int) -> Tuple[Tuple[int, int], ...]:
+    """K disjoint contiguous [start, end) ranges covering [0, n).
+
+    Matches the reference's hand-written thirds for (50000, 3):
+    (0,16666), (16666,33333), (33333,50000).
+    """
+    bounds = [n * i // k for i in range(k + 1)]
+    return tuple((bounds[i], bounds[i + 1]) for i in range(k))
+
+
+def client_stats(k: int, biased: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-client normalization constants, shaped [K] (scalar per client)."""
+    if biased:
+        stats = [BIASED_STATS[i % len(BIASED_STATS)] for i in range(k)]
+    else:
+        stats = [UNBIASED_STAT] * k
+    means = np.asarray([m for m, _ in stats], np.float32)
+    stds = np.asarray([s for _, s in stats], np.float32)
+    return means, stds
+
+
+def normalize(images_u8: jnp.ndarray, mean: jnp.ndarray, std: jnp.ndarray) -> jnp.ndarray:
+    """Jittable on-device `(x/255 - mean)/std`.
+
+    `images_u8` is `[..., H, W, C]` uint8. `mean`/`std` may be scalars or
+    arrays whose axes align with the LEADING axes of `images_u8` — e.g. the
+    `[K]` per-client stats against a `[K, B, H, W, C]` stacked batch; they
+    are reshaped to `[K, 1, 1, 1, 1]` here so they can never silently
+    broadcast against the trailing channel axis (K == C == 3 in the
+    flagship trio configuration). Equivalent of torchvision
+    `ToTensor()+Normalize(...)` (reference src/no_consensus_trio.py:34-45)
+    moved into the XLA program.
+    """
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if mean.ndim:
+        mean = mean.reshape(mean.shape + (1,) * (images_u8.ndim - mean.ndim))
+    if std.ndim:
+        std = std.reshape(std.shape + (1,) * (images_u8.ndim - std.ndim))
+    x = images_u8.astype(jnp.float32) / 255.0
+    return (x - mean) / std
+
+
+@dataclasses.dataclass
+class FederatedDataset:
+    """Host-side federated view of a `DataSource` for K clients.
+
+    train_images: [K, n, 32, 32, 3] uint8 (disjoint shards, truncated to the
+      smallest shard so the stack is rectangular)
+    test_images:  [M, 32, 32, 3] uint8 (shared; every client normalizes it
+      with its own stats on device)
+    mean/std: [K] float32 per-client normalization scalars
+    """
+
+    train_images: np.ndarray
+    train_labels: np.ndarray
+    test_images: np.ndarray
+    test_labels: np.ndarray
+    mean: np.ndarray
+    std: np.ndarray
+    num_classes: int
+
+    @property
+    def n_clients(self) -> int:
+        return self.train_images.shape[0]
+
+    @property
+    def shard_size(self) -> int:
+        return self.train_images.shape[1]
+
+    def steps_per_epoch(self, batch: int) -> int:
+        return self.shard_size // batch
+
+    def epoch(
+        self, batch: int, seed: int
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield lockstep stacked batches `([K,B,32,32,3] u8, [K,B] i32)`.
+
+        Each client's shard is independently reshuffled every epoch —
+        the `SubsetRandomSampler` equivalent (reference
+        src/no_consensus_trio.py:59-61) — with a deterministic seed.
+        """
+        k, n = self.train_images.shape[:2]
+        rng = np.random.default_rng(seed)
+        perms = np.stack([rng.permutation(n) for _ in range(k)])  # [K, n]
+        for step in range(self.steps_per_epoch(batch)):
+            idx = perms[:, step * batch : (step + 1) * batch]  # [K, B]
+            images = np.take_along_axis(
+                self.train_images, idx[:, :, None, None, None], axis=1
+            )
+            labels = np.take_along_axis(self.train_labels, idx, axis=1)
+            yield images, labels
+
+    def test_batches(
+        self, batch: int
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Full-test-set sweep in `[B]` batches (shared across clients; pad
+        the tail by repeating the last sample, with a validity mask)."""
+        m = self.test_images.shape[0]
+        for start in range(0, m, batch):
+            idx = np.arange(start, min(start + batch, m))
+            pad = batch - idx.size
+            mask = np.concatenate([np.ones(idx.size, bool), np.zeros(pad, bool)])
+            if pad:
+                idx = np.concatenate([idx, np.full(pad, m - 1)])
+            yield self.test_images[idx], self.test_labels[idx], mask
+
+
+def make_federated(
+    source: DataSource, n_clients: int, biased: bool = True
+) -> FederatedDataset:
+    """Shard a `DataSource` across K clients with per-client normalization."""
+    splits = client_splits(source.train_images.shape[0], n_clients)
+    n_min = min(e - s for s, e in splits)
+    tr_i = np.stack([source.train_images[s : s + n_min] for s, _ in splits])
+    tr_l = np.stack(
+        [source.train_labels[s : s + n_min].astype(np.int32) for s, _ in splits]
+    )
+    mean, std = client_stats(n_clients, biased)
+    return FederatedDataset(
+        train_images=tr_i,
+        train_labels=tr_l,
+        test_images=source.test_images,
+        test_labels=source.test_labels.astype(np.int32),
+        mean=mean,
+        std=std,
+        num_classes=source.num_classes,
+    )

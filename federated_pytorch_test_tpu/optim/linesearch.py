@@ -162,12 +162,14 @@ def cubic_linesearch(
     phi_0: Scalar,
     lr: float,
     step: float = 1e-6,
-    max_iters: int = 4,
+    max_iters: int = 3,
 ) -> Scalar:
     """Strong-Wolfe cubic line search; reference src/lbfgsnew.py:179-303.
 
     `phi(alpha) = loss(x + alpha * d)`, `phi_0 = phi(0)` (already evaluated).
-    Returns the chosen step size.
+    Returns the chosen step size. The outer bracketing loop runs at most 3
+    extrapolations (reference `ci=1; while ci<4`, src/lbfgsnew.py:232-236);
+    the zoom stage at most 4 (`ci=0; while ci<4`, :421-423).
     """
     consts = _CubicConsts()
     dt = jnp.asarray(phi_0).dtype

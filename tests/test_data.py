@@ -1,0 +1,121 @@
+"""Data pipeline tests: splits, normalization, lockstep batching."""
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.data import (
+    client_splits,
+    client_stats,
+    make_federated,
+    normalize,
+    synthetic_cifar,
+)
+
+
+def test_client_splits_match_reference_thirds():
+    # reference src/no_consensus_trio.py:28-30
+    assert client_splits(50_000, 3) == ((0, 16666), (16666, 33333), (33333, 50000))
+
+
+def test_client_splits_cover_disjoint():
+    splits = client_splits(1000, 7)
+    assert splits[0][0] == 0 and splits[-1][1] == 1000
+    for (s0, e0), (s1, e1) in zip(splits, splits[1:]):
+        assert e0 == s1
+
+
+def test_biased_stats_match_reference():
+    # reference src/no_consensus_trio.py:34-45
+    mean, std = client_stats(3, biased=True)
+    np.testing.assert_allclose(mean, [0.5, 0.3, 0.6])
+    np.testing.assert_allclose(std, [0.5, 0.4, 0.5])
+    mean_u, std_u = client_stats(3, biased=False)
+    np.testing.assert_allclose(mean_u, 0.5)
+    np.testing.assert_allclose(std_u, 0.5)
+
+
+def test_normalize_matches_torchvision_formula():
+    img = np.arange(2 * 2 * 3, dtype=np.uint8).reshape(1, 2, 2, 3) * 20
+    out = np.asarray(normalize(img, 0.3, 0.4))
+    np.testing.assert_allclose(out, (img / 255.0 - 0.3) / 0.4, rtol=1e-6)
+
+
+def test_normalize_per_client_stats_broadcast_on_client_axis():
+    # K == C == 3: [K] stats must hit the leading client axis, never the
+    # trailing channel axis
+    img = np.full((3, 2, 4, 4, 3), 128, np.uint8)  # [K,B,H,W,C] uniform gray
+    mean, std = client_stats(3, biased=True)
+    out = np.asarray(normalize(img, mean, std))
+    x = 128 / 255.0
+    for k, (m, s) in enumerate(zip(mean, std)):
+        np.testing.assert_allclose(out[k], (x - m) / s, rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def fed():
+    src = synthetic_cifar(n_train=600, n_test=100, num_classes=10, seed=0)
+    return make_federated(src, n_clients=3, biased=True)
+
+
+def test_federated_shapes(fed):
+    assert fed.train_images.shape == (3, 200, 32, 32, 3)
+    assert fed.train_images.dtype == np.uint8
+    assert fed.test_images.shape == (100, 32, 32, 3)
+
+
+def test_shards_disjoint(fed):
+    # contiguous split of a deterministic source: shard contents differ
+    assert not np.array_equal(fed.train_images[0], fed.train_images[1])
+
+
+def test_epoch_lockstep_batches(fed):
+    batches = list(fed.epoch(batch=64, seed=1))
+    assert len(batches) == 200 // 64
+    imgs, labels = batches[0]
+    assert imgs.shape == (3, 64, 32, 32, 3)
+    assert labels.shape == (3, 64)
+    assert labels.dtype == np.int32
+
+
+def test_epoch_reshuffles_and_is_deterministic(fed):
+    a = list(fed.epoch(batch=64, seed=1))
+    b = list(fed.epoch(batch=64, seed=1))
+    c = list(fed.epoch(batch=64, seed=2))
+    np.testing.assert_array_equal(a[0][1], b[0][1])
+    assert not np.array_equal(a[0][1], c[0][1])
+
+
+def test_epoch_samples_only_own_shard(fed):
+    # every emitted image of client k must come from shard k
+    shard0 = fed.train_images[0].reshape(200, -1)
+    for imgs, _ in fed.epoch(batch=64, seed=3):
+        emitted = imgs[0].reshape(64, -1)
+        # membership via row-hash
+        h_shard = {r.tobytes() for r in shard0}
+        assert all(r.tobytes() in h_shard for r in emitted)
+        break
+
+
+def test_test_batches_pad_and_mask(fed):
+    batches = list(fed.test_batches(batch=64))
+    assert len(batches) == 2
+    imgs, labels, mask = batches[-1]
+    assert imgs.shape == (64, 32, 32, 3)
+    assert mask.sum() == 100 - 64
+    total = sum(m.sum() for _, _, m in batches)
+    assert total == 100
+
+
+def test_synthetic_learnable_separation():
+    # class prototypes should make a nearest-centroid rule beat chance easily
+    src = synthetic_cifar(n_train=2000, n_test=500, num_classes=10, seed=0)
+    x = src.train_images.reshape(2000, -1).astype(np.float32)
+    cents = np.stack(
+        [x[src.train_labels == c].mean(0) for c in range(10)]
+    )
+    xt = src.test_images.reshape(500, -1).astype(np.float32)
+    pred = np.argmin(
+        ((xt[:, None] - cents[None]) ** 2).sum(-1), axis=1
+    )
+    acc = (pred == src.test_labels).mean()
+    assert acc > 0.5
