@@ -1,0 +1,38 @@
+"""Consensus strategies: FedAvg, ADMM (+ Barzilai-Borwein adaptive rho).
+
+The reference inlines these algorithms into each driver script (SURVEY.md
+§1 L5); here they are pure SPMD functions designed to run INSIDE a
+`shard_map` over the `clients` mesh axis, operating on the local client
+block `[K_loc, N]` of the active partition group's flat coordinates. Their
+only cross-client communication is the weighted-psum collectives of
+`federated_pytorch_test_tpu.parallel` — exactly one masked-group vector
+crosses the interconnect per round (reference README.md:2's bandwidth
+contract).
+"""
+
+from federated_pytorch_test_tpu.consensus.admm import (
+    ADMMConfig,
+    ADMMState,
+    admm_init,
+    admm_penalty,
+    admm_round,
+)
+from federated_pytorch_test_tpu.consensus.fedavg import (
+    FedAvgState,
+    fedavg_init,
+    fedavg_round,
+)
+from federated_pytorch_test_tpu.consensus.penalties import elastic_net, soft_threshold
+
+__all__ = [
+    "ADMMConfig",
+    "ADMMState",
+    "FedAvgState",
+    "admm_init",
+    "admm_penalty",
+    "admm_round",
+    "elastic_net",
+    "fedavg_init",
+    "fedavg_round",
+    "soft_threshold",
+]

@@ -1,0 +1,172 @@
+"""ADMM consensus with optional Barzilai-Borwein adaptive penalty.
+
+The reference's three-step ADMM (src/consensus_admm_trio.py:375-513):
+
+  x-update: each client minimizes `loss + y·(x−z) + ρ/2‖x−z‖²` with the
+            inner L-BFGS (closures :343-373) — here `admm_penalty` is the
+            augmented-Lagrangian term added to the per-client loss;
+  z-update: `znew = Σ_k (y_k + ρ_k x_k) / Σ_k ρ_k` (:502) — a weighted
+            psum over the clients axis;
+  y-update: `y_k += ρ_k (x_k − znew)` (:511-513).
+
+Residuals (:503,514): dual `‖z − znew‖/N`, primal `Σ_k ‖x_k − znew‖/(K·N)`.
+
+The BB spectral penalty adaptation (src/consensus_admm_trio.py:399-498,
+hyper-params :37-44) runs every `bb_period` ADMM iterations (not the
+first): with `ŷ = y + ρ(x−z)` (OLD rho), `Δy = ŷ − ŷ⁰`, `Δx = x − x⁰`,
+inner products d11=Δy·Δy, d12=Δy·Δx, d22=Δx·Δx gate the update
+(all > ε, |d12| > ε); the correlation `α = d12/√(d11·d22)`, steepest-
+descent `αSD = d11/d12` and minimum-gradient `αMG = d12/d22` steps combine
+into the hybrid `α̂ = αMG if 2αMG > αSD else αSD − αMG/2`, accepted iff
+`α ≥ corr_min ∧ α̂ < ρ_max`. The z-update then uses the NEW rho while ŷ
+was formed with the old one — reference ordering (:407 before :502),
+preserved. Reference quirks kept: `ŷ⁰` initializes to the partition's
+starting parameter values, not zeros (:299-302); `x⁰` and `ŷ⁰` are
+(re)stored at nadmm==0 and at every DUE BB step — whether or not the
+proposal was accepted (:401-405,494-498).
+
+Everything is per-client elementwise math except the z-update's weighted
+psum, so the whole round is one SPMD function over the local client block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.parallel import client_count, client_sum, weighted_client_mean
+
+
+@dataclasses.dataclass(frozen=True)
+class ADMMConfig:
+    """Hyper-parameters (reference src/consensus_admm_trio.py:23,37-44)."""
+
+    rho0: float = 0.001
+    bb_update: bool = False
+    bb_period: int = 2
+    bb_alphacorrmin: float = 0.2
+    bb_epsilon: float = 1e-3
+    bb_rhomax: float = 0.1
+
+
+class ADMMState(NamedTuple):
+    y: jnp.ndarray  # [K_loc, N] scaled duals, client-local
+    z: jnp.ndarray  # [N] consensus vector, replicated
+    rho: jnp.ndarray  # [K_loc, 1] per-client penalty
+    yhat0: jnp.ndarray  # [K_loc, N] BB: previous y-hat
+    x0: jnp.ndarray  # [K_loc, N] BB: previous x
+
+
+def admm_init(x_local: jnp.ndarray, config: ADMMConfig) -> ADMMState:
+    """Fresh per-partition state from the group's starting coordinates.
+
+    y and z start at zero (reference src/consensus_admm_trio.py:281-288);
+    ŷ⁰ starts at the current parameter values (:299-302, quirk preserved).
+
+    Per-client leaves are derived from `x_local` (zeros as `x*0`) so that,
+    under `shard_map`, they carry the client axis's varying-manual-axes tag
+    and a `lax.scan` over `admm_round` has matching carry types; `z` is a
+    plain constant, matching the axis-invariant output of the z-update's
+    psum.
+    """
+    n = x_local.shape[-1]
+    zero = x_local * 0
+    return ADMMState(
+        y=zero,
+        z=jnp.zeros((n,), x_local.dtype),
+        rho=zero[:, :1] + jnp.asarray(config.rho0, x_local.dtype),
+        yhat0=x_local,
+        x0=zero,
+    )
+
+
+def admm_penalty(
+    x: jnp.ndarray, y: jnp.ndarray, z: jnp.ndarray, rho: jnp.ndarray
+) -> jnp.ndarray:
+    """Augmented-Lagrangian term `y·(x−z) + ρ/2·‖x−z‖²` for ONE client.
+
+    Added to the client's data loss inside the x-update closure (reference
+    src/consensus_admm_trio.py:343). vmap over the local client block.
+    """
+    diff = x - z
+    return jnp.dot(y, diff) + 0.5 * jnp.squeeze(rho) * jnp.dot(diff, diff)
+
+
+def _bb_new_rho(
+    rho: jnp.ndarray,
+    yhat: jnp.ndarray,
+    yhat0: jnp.ndarray,
+    x: jnp.ndarray,
+    x0: jnp.ndarray,
+    config: ADMMConfig,
+) -> jnp.ndarray:
+    """One client's BB spectral rho proposal (reference
+    src/consensus_admm_trio.py:407-429). All branches are computed with
+    safe denominators and selected by masks (XLA evaluates both sides of a
+    `where`)."""
+    dy = yhat - yhat0
+    dx = x - x0
+    d11 = jnp.dot(dy, dy)
+    d12 = jnp.dot(dy, dx)  # can be negative
+    d22 = jnp.dot(dx, dx)
+    eps = config.bb_epsilon
+    well_posed = (jnp.abs(d12) > eps) & (d11 > eps) & (d22 > eps)
+
+    d12s = jnp.where(jnp.abs(d12) > eps, d12, 1.0)
+    prod = jnp.where(well_posed, d11 * d22, 1.0)
+    alpha = d12s / jnp.sqrt(prod)
+    alpha_sd = d11 / d12s
+    alpha_mg = d12s / jnp.where(d22 > eps, d22, 1.0)
+    alpha_hat = jnp.where(2.0 * alpha_mg > alpha_sd, alpha_mg, alpha_sd - 0.5 * alpha_mg)
+
+    accept = well_posed & (alpha >= config.bb_alphacorrmin) & (alpha_hat < config.bb_rhomax)
+    return jnp.where(accept, alpha_hat, jnp.squeeze(rho))[None]
+
+
+class ADMMMetrics(NamedTuple):
+    primal_residual: jnp.ndarray
+    dual_residual: jnp.ndarray
+    mean_rho: jnp.ndarray
+
+
+def admm_round(
+    x_local: jnp.ndarray, state: ADMMState, nadmm: jnp.ndarray, config: ADMMConfig
+) -> Tuple[ADMMState, ADMMMetrics]:
+    """BB adaptation (if due) + z-update + y-update for one ADMM iteration.
+
+    `x_local` is the local client block `[K_loc, N]` after the x-update
+    (the inner L-BFGS round); `nadmm` is the (traced) ADMM iteration index
+    within the current partition round.
+    """
+    n = x_local.shape[-1]
+    k = client_count(x_local)
+
+    if config.bb_update:
+        is_first = nadmm == 0
+        due = (nadmm > 0) & (nadmm % config.bb_period == 0)
+        yhat = state.y + state.rho * (x_local - state.z)  # OLD rho
+        rho_prop = jax.vmap(_bb_new_rho, in_axes=(0, 0, 0, 0, 0, None))(
+            state.rho, yhat, state.yhat0, x_local, state.x0, config
+        )
+        rho = jnp.where(due, rho_prop, state.rho)
+        x0 = jnp.where(is_first | due, x_local, state.x0)
+        yhat0 = jnp.where(due, yhat, state.yhat0)
+    else:
+        rho, x0, yhat0 = state.rho, state.x0, state.yhat0
+
+    # z-update: weighted mean with v = y/rho + x, w = rho so that
+    # sum(v*w)/sum(w) == sum(y + rho*x)/sum(rho) (reference :502)
+    znew = weighted_client_mean(state.y / rho + x_local, rho)
+    dual = jnp.linalg.norm(state.z - znew) / n
+
+    # y-update (reference :511-513)
+    y = state.y + rho * (x_local - znew)
+
+    primal = client_sum(jnp.linalg.norm(x_local - znew, axis=-1)) / (k * n)
+    mean_rho = client_sum(jnp.sum(rho, axis=-1)) / k
+
+    new_state = ADMMState(y=y, z=znew, rho=rho, yhat0=yhat0, x0=x0)
+    return new_state, ADMMMetrics(primal, dual, mean_rho)

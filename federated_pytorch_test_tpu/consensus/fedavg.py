@@ -1,0 +1,44 @@
+"""Partial-parameter federated averaging.
+
+Reference src/federated_trio.py:353-363: after each inner-optimization
+round, the active partition group's coordinates are averaged across
+clients, `znew = (x_1 + x_2 + x_3)/3`, the dual residual `‖z − znew‖/N` is
+reported (z starts at 0, so the first residual is just `‖znew‖/N` — a
+reference quirk preserved here), and znew is broadcast back into every
+client's network.
+
+SPMD form: `fedavg_round` runs inside `shard_map`; the average is one
+`psum` over the clients axis on the masked group vector, and the returned
+`z` is replicated, so "broadcast back" is a local `Partition.insert`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.parallel import client_mean
+
+
+class FedAvgState(NamedTuple):
+    z: jnp.ndarray  # [N] consensus vector, replicated across devices
+
+
+def fedavg_init(n: int, dtype=jnp.float32) -> FedAvgState:
+    """z starts at zero (reference src/federated_trio.py:266-268)."""
+    return FedAvgState(z=jnp.zeros((n,), dtype))
+
+
+def fedavg_round(
+    x_local: jnp.ndarray, state: FedAvgState
+) -> Tuple[FedAvgState, dict]:
+    """One averaging round over the local client block `[K_loc, N]`.
+
+    Returns the new state (z = cross-client mean) and the dual residual
+    `‖z − znew‖/N` (reference src/federated_trio.py:357-358).
+    """
+    n = x_local.shape[-1]
+    znew = client_mean(x_local)
+    dual = jnp.linalg.norm(state.z - znew) / n
+    return FedAvgState(z=znew), {"dual_residual": dual}

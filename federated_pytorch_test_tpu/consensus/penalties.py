@@ -1,0 +1,24 @@
+"""Regularization helpers: elastic net on linear partitions, soft threshold.
+
+Reference src/federated_trio.py:303-333 adds
+`λ1‖v‖₁ + λ2‖v‖₂²` to the loss when the active partition is a linear
+layer (`ci in net.linear_layer_ids()`); `sthreshold` (reference
+src/federated_trio.py:188-196, a torch Softshrink) is the proximal
+operator kept for the commented-out elastic-net z-update variant
+(reference src/consensus_admm_trio_resnet.py:416-419).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def elastic_net(v: jnp.ndarray, lambda1: float, lambda2: float) -> jnp.ndarray:
+    """`λ1‖v‖₁ + λ2‖v‖₂²` (reference src/federated_trio.py:309-310)."""
+    return lambda1 * jnp.sum(jnp.abs(v)) + lambda2 * jnp.sum(v * v)
+
+
+def soft_threshold(z: jnp.ndarray, sval: float) -> jnp.ndarray:
+    """Soft shrinkage `sign(z)·max(|z|−sval, 0)` (reference
+    src/federated_trio.py:188-196)."""
+    return jnp.sign(z) * jnp.maximum(jnp.abs(z) - sval, 0.0)

@@ -61,26 +61,54 @@ def _load_pickle_batches(root: str, files, label_key: bytes):
     return np.concatenate(images), np.concatenate(labels)
 
 
+def _load_bin_records(root: str, files, label_bytes: int):
+    """The binary archive layout: each record is `label_bytes` label bytes
+    followed by 3072 image bytes (fine label is the last label byte)."""
+    images, labels = [], []
+    rec = label_bytes + 3072
+    for fn in files:
+        raw = np.fromfile(os.path.join(root, fn), np.uint8).reshape(-1, rec)
+        labels.append(raw[:, label_bytes - 1].astype(np.int32))
+        images.append(_planes_to_hwc(raw[:, label_bytes:]))
+    return np.concatenate(images), np.concatenate(labels)
+
+
 def load_cifar10(root: str) -> DataSource:
-    """Load CIFAR-10 from `root` (accepts the dir containing, or equal to,
-    ``cifar-10-batches-py``; a ``cifar-10-python.tar.gz`` is unpacked)."""
-    root = _resolve(root, "cifar-10-batches-py", "cifar-10-python.tar.gz")
-    tr_i, tr_l = _load_pickle_batches(
-        root, [f"data_batch_{i}" for i in range(1, 6)], b"labels"
-    )
-    te_i, te_l = _load_pickle_batches(root, ["test_batch"], b"labels")
+    """Load CIFAR-10 from `root`: either the python-pickle layout
+    (``cifar-10-batches-py``, tarball ``cifar-10-python.tar.gz``) or the
+    binary layout (``cifar-10-batches-bin``); `root` may be the directory
+    containing the archive dir or the archive dir itself."""
+    try:
+        d = _resolve(root, "cifar-10-batches-py", "cifar-10-python.tar.gz")
+        tr_i, tr_l = _load_pickle_batches(
+            d, [f"data_batch_{i}" for i in range(1, 6)], b"labels"
+        )
+        te_i, te_l = _load_pickle_batches(d, ["test_batch"], b"labels")
+    except ArchiveNotFound:
+        d = _resolve(root, "cifar-10-batches-bin", "cifar-10-binary.tar.gz")
+        tr_i, tr_l = _load_bin_records(
+            d, [f"data_batch_{i}.bin" for i in range(1, 6)], 1
+        )
+        te_i, te_l = _load_bin_records(d, ["test_batch.bin"], 1)
     return DataSource(tr_i, tr_l, te_i, te_l, 10, "cifar10")
 
 
 def load_cifar100(root: str) -> DataSource:
-    root = _resolve(root, "cifar-100-python", "cifar-100-python.tar.gz")
-    tr_i, tr_l = _load_pickle_batches(root, ["train"], b"fine_labels")
-    te_i, te_l = _load_pickle_batches(root, ["test"], b"fine_labels")
+    try:
+        d = _resolve(root, "cifar-100-python", "cifar-100-python.tar.gz")
+        tr_i, tr_l = _load_pickle_batches(d, ["train"], b"fine_labels")
+        te_i, te_l = _load_pickle_batches(d, ["test"], b"fine_labels")
+    except ArchiveNotFound:
+        d = _resolve(root, "cifar-100-binary", "cifar-100-binary.tar.gz")
+        tr_i, tr_l = _load_bin_records(d, ["train.bin"], 2)  # coarse+fine
+        te_i, te_l = _load_bin_records(d, ["test.bin"], 2)
     return DataSource(tr_i, tr_l, te_i, te_l, 100, "cifar100")
 
 
 def _resolve(root: str, subdir: str, tarball: str) -> str:
     if os.path.basename(os.path.normpath(root)) == subdir:
+        if not os.path.isdir(root):
+            raise ArchiveNotFound(f"{root} does not exist")
         return root
     cand = os.path.join(root, subdir)
     if os.path.isdir(cand):

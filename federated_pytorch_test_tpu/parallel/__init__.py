@@ -1,0 +1,41 @@
+"""Client-axis parallelism: meshes, shardings, and XLA collectives.
+
+This package is the distributed communication backend the reference lacks
+(SURVEY.md §2.4): the `clients` mesh axis replaces the reference's three
+sequentially-stepped replicas, and weighted `psum` collectives replace its
+in-process flat-vector copies.
+"""
+
+from federated_pytorch_test_tpu.parallel.collectives import (
+    all_clients,
+    client_count,
+    client_mean,
+    client_sum,
+    weighted_client_mean,
+)
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    client_mesh,
+    client_sharding,
+    largest_feasible_mesh,
+    mesh_size,
+    replicate,
+    replicated_sharding,
+    shard_clients,
+)
+
+__all__ = [
+    "CLIENT_AXIS",
+    "all_clients",
+    "client_count",
+    "client_mean",
+    "client_mesh",
+    "client_sharding",
+    "client_sum",
+    "largest_feasible_mesh",
+    "mesh_size",
+    "replicate",
+    "replicated_sharding",
+    "shard_clients",
+    "weighted_client_mean",
+]

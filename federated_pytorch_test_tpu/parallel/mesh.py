@@ -1,0 +1,77 @@
+"""Device meshes and shardings for the client axis.
+
+The reference "cluster" is three model replicas stepped sequentially in one
+process (reference src/federated_trio.py:336-338). Here clients are a named
+mesh axis: stacked `[K, ...]` arrays are sharded across devices on that
+axis and one jitted, `shard_map`ped function steps every client
+simultaneously, with XLA collectives over ICI/DCN where the reference does
+Python-side tensor copies (reference src/consensus_admm_trio.py:501-513).
+
+K need not equal the device count: any D dividing K works — each device
+then carries a local block of K/D clients (the single-real-chip benchmark
+runs K=3 on D=1; a v4-64 runs K=64 on D=64). Per-client compute vmaps over
+the local block; cross-client collectives reduce the local axis before the
+`psum` (see `collectives.py`).
+
+The mesh is built with a trailing unused `model` axis slot reserved in the
+axis-name universe so tensor/sequence axes can be added later without
+renaming (SURVEY.md §2.3 non-goals).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+
+PyTree = Any
+
+
+def client_mesh(
+    n_devices: int | None = None, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """A 1-D mesh over `n_devices` devices with the `clients` axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (CLIENT_AXIS,))
+
+
+def mesh_size(mesh: Mesh) -> int:
+    return mesh.shape[CLIENT_AXIS]
+
+
+def largest_feasible_mesh(n_clients: int, max_devices: int | None = None) -> Mesh:
+    """Largest device count D ≤ available that divides K (one local block of
+    K/D clients per device)."""
+    avail = len(jax.devices()) if max_devices is None else min(max_devices, len(jax.devices()))
+    d = max(d for d in range(1, min(n_clients, avail) + 1) if n_clients % d == 0)
+    return client_mesh(d)
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding placing the leading (client) axis across the mesh."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_clients(tree: PyTree, mesh: Mesh) -> PyTree:
+    """device_put every `[K, ...]` leaf sharded on the client axis."""
+    sh = client_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
+    sh = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

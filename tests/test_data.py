@@ -106,6 +106,44 @@ def test_test_batches_pad_and_mask(fed):
     assert total == 100
 
 
+def test_bin_format_roundtrip(tmp_path):
+    # write a tiny cifar-10-batches-bin layout and read it back
+    import os
+
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    n = 4
+    img = rng.integers(0, 256, size=(n, 3, 32, 32), dtype=np.uint8)
+    lab = rng.integers(0, 10, size=(n, 1), dtype=np.uint8)
+    rec = np.concatenate([lab, img.reshape(n, -1)], axis=1)
+    for i in range(1, 6):
+        rec.tofile(os.fspath(d / f"data_batch_{i}.bin"))
+    rec.tofile(os.fspath(d / "test_batch.bin"))
+
+    from federated_pytorch_test_tpu.data import load_cifar10
+
+    src = load_cifar10(os.fspath(tmp_path))
+    assert src.train_images.shape == (5 * n, 32, 32, 3)
+    np.testing.assert_array_equal(src.test_labels, lab[:, 0])
+    # HWC conversion: plane-major bytes -> channel-last pixels
+    np.testing.assert_array_equal(
+        src.test_images[0, :, :, 0], img[0, 0]
+    )
+
+
+def test_missing_root_falls_back_to_synthetic(tmp_path):
+    import warnings as w
+
+    from federated_pytorch_test_tpu.data import load_cifar
+
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        src = load_cifar("cifar10", root=str(tmp_path / "nope"))
+    assert src.name == "synthetic"
+    assert any("synthetic" in str(x.message) for x in rec)
+
+
 def test_synthetic_learnable_separation():
     # class prototypes should make a nearest-centroid rule beat chance easily
     src = synthetic_cifar(n_train=2000, n_test=500, num_classes=10, seed=0)
