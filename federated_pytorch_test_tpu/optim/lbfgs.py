@@ -400,7 +400,20 @@ def lbfgs_step(
         done=abs_grad_sum0 <= tol_grad,
     )
 
-    final = lax.while_loop(cond, body, init)
+    def masked_body(c: _Carry) -> _Carry:
+        # vmap-safety: under `jax.vmap` the while body runs for every
+        # client while ANY client's condition holds; a client that already
+        # terminated must keep its carry frozen or its params would take
+        # extra L-BFGS iterations its siblings are still running. The NaN
+        # clause mirrors the loop guard: a client entering with a NaN
+        # gradient must keep its params untouched (reference
+        # src/lbfgsnew.py:541-542), not absorb a NaN step from the batched
+        # body.
+        new = body(c)
+        frozen = c.done | jnp.isnan(grad_nrm)
+        return jax.tree.map(lambda n, o: jnp.where(frozen, o, n), new, c)
+
+    final = lax.while_loop(cond, masked_body, init)
 
     new_state = LBFGSState(
         s_hist=final.s_hist,

@@ -47,6 +47,11 @@ def backtracking_armijo(
     last step is returned even if the condition never held.
 
     Returns `(alpha, n_evals)`.
+
+    vmap-safe: under `jax.vmap` a `while_loop` body runs for every batch
+    element while ANY element's condition holds, so the halving is masked
+    per element — a client whose Armijo condition already holds keeps its
+    step unchanged while siblings continue backtracking.
     """
     prod = c1 * gtd
 
@@ -55,9 +60,11 @@ def backtracking_armijo(
         return jnp.logical_and(ci < max_iters, f_new > f_old + alpha * prod)
 
     def body(carry):
-        ci, alpha, _ = carry
-        alpha = 0.5 * alpha
-        return ci + 1, alpha, phi(alpha)
+        ci, alpha, f_new = carry
+        active = (f_new > f_old + alpha * prod) & (ci < max_iters)
+        alpha_new = jnp.where(active, 0.5 * alpha, alpha)
+        f_next = jnp.where(active, phi(alpha_new), f_new)
+        return ci + active.astype(jnp.int32), alpha_new, f_next
 
     f1 = phi(alphabar)
     ci, alpha, _ = lax.while_loop(cond, body, (jnp.int32(0), alphabar, f1))
@@ -117,14 +124,19 @@ def _zoom(
     step: float,
     max_iters: int = 4,
 ) -> Scalar:
-    """Zoom stage on bracket [a,b]; reference src/lbfgsnew.py:399-482."""
+    """Zoom stage on bracket [a,b]; reference src/lbfgsnew.py:399-482.
+
+    vmap-safe: once an element's `found` flag is set its carry is frozen
+    (under vmap the body keeps running while any sibling still searches,
+    and the bracket update would otherwise drift past the accepted step).
+    """
 
     def cond(carry):
         ci, _, _, _, found = carry
         return jnp.logical_and(ci < max_iters, jnp.logical_not(found))
 
     def body(carry):
-        ci, aj, bj, alphak, _ = carry
+        ci, aj, bj, alphak, found = carry
         p01 = aj + consts.t2 * (bj - aj)
         p02 = bj - consts.t3 * (bj - aj)
         alphaj = _cubic_interpolate(phi, p01, p02, step)
@@ -149,7 +161,16 @@ def _zoom(
             jnp.where(gphi_j * (bj - aj) >= 0.0, aj, bj),
         )
         aj_new = jnp.where(armijo_fail, aj, alphaj)
-        return ci + 1, aj_new, bj_new, alphaj, found_now
+        # (`ci` increments unconditionally, so it is uniform across a vmap
+        # batch and exhaustion ends the batched loop globally — only the
+        # per-element `found` flag needs freezing.)
+        return (
+            ci + 1,
+            jnp.where(found, aj, aj_new),
+            jnp.where(found, bj, bj_new),
+            jnp.where(found, alphak, alphaj),
+            found | found_now,
+        )
 
     _, _, _, alphak, _ = lax.while_loop(
         cond, body, (jnp.int32(0), a, b, a, jnp.bool_(False))
@@ -184,7 +205,7 @@ def cubic_linesearch(
         return jnp.logical_and(ci < max_iters, code == 0)
 
     def body(carry):
-        ci, alphai, alphai1, phi_prev, _ = carry
+        ci, alphai, alphai1, phi_prev, code_in = carry
         phi_i = phi(alphai)
 
         accept0 = phi_i < tol
@@ -210,13 +231,18 @@ def cubic_linesearch(
         alphai_next = jnp.where(take_mu, mu, alphai_interp)
         alphai1_next = jnp.where(take_mu, alphai, alphai1)
 
-        keep = code == 0
+        # vmap-safety: an element that already exited (code_in != 0) must
+        # keep its carry bit-identical — re-running the body with the
+        # incremented ci can flip `bracket1`'s `ci > 0` clause and change
+        # the exit code (see module docstring on batched while_loops).
+        frozen = code_in != 0  # ci is batch-uniform; only code varies
+        keep = (code == 0) & ~frozen
         return (
             ci + 1,
             jnp.where(keep, alphai_next, alphai),
             jnp.where(keep, alphai1_next, alphai1),
             jnp.where(keep, phi_i, phi_prev),
-            code,
+            jnp.where(frozen, code_in, code),
         )
 
     alpha1 = jnp.asarray(10.0 * lr, dt)

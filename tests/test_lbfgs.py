@@ -128,6 +128,31 @@ def test_step_is_jittable_and_pure():
     np.testing.assert_array_equal(np.asarray(s1.d), np.asarray(s2.d))
 
 
+def test_nan_client_isolated_under_vmap():
+    # One client with a NaN loss must come out of a vmapped step with its
+    # params untouched while healthy siblings still optimize (the batched
+    # while body runs for everyone; the NaN client's carry must be frozen).
+    loss_good, _ = _quadratic(n=6, seed=9)
+    cfg = LBFGSConfig(max_iter=4, history_size=3, line_search=True)
+    switches = jnp.asarray([0.0, 1.0], jnp.float32)  # 1.0 => NaN loss
+
+    def one(x, sw):
+        def loss(xx):
+            return jnp.where(sw > 0.5, jnp.nan, 1.0) * loss_good(xx)
+
+        state = lbfgs_init(x, cfg)
+        x1, _, aux = lbfgs_step(loss, x, state, cfg)
+        return x1, aux.n_inner
+
+    x0 = jnp.ones((2, 6), jnp.float32)
+    x1, n_inner = jax.vmap(one)(x0, switches)
+    np.testing.assert_array_equal(np.asarray(x1[1]), np.asarray(x0[1]))
+    assert int(n_inner[1]) == 0
+    # the healthy client actually moved
+    assert float(jnp.linalg.norm(x1[0] - x0[0])) > 1e-3
+    assert np.isfinite(np.asarray(x1[0])).all()
+
+
 def test_nan_gradient_leaves_params_unchanged():
     # reference src/lbfgsnew.py:541-542: a NaN gradient norm at entry skips
     # the whole optimization loop.
@@ -165,6 +190,62 @@ def test_float64_dtype_generic():
         np.testing.assert_allclose(np.asarray(x), x_star, atol=1e-5)
     finally:
         jax.config.update("jax_enable_x64", False)
+
+
+@pytest.mark.parametrize("batch_mode", [False, True])
+def test_vmap_matches_sequential(batch_mode):
+    # The engine vmaps lbfgs_step over the local client block; a batched
+    # while_loop keeps running every element until ALL are done, so the
+    # bodies must freeze finished elements. Heterogeneous problems (very different
+    # conditioning => different line-search/iteration counts) must match
+    # between vmapped and one-at-a-time execution.
+    #
+    # The full-batch cubic search estimates derivatives by central
+    # differences with step 1e-6 (reference src/lbfgsnew.py:209-217), which
+    # sits at f32's resolution limit of the loss — batched-vs-unbatched
+    # matvec reduction-order noise gets chaotically amplified there. So the
+    # cubic variant is checked in f64 where the probe is well-conditioned;
+    # the Armijo variant (what every reference driver uses) is checked in
+    # f32, the training dtype.
+    dtype = jnp.float32 if batch_mode else jnp.float64
+    if not batch_mode:
+        jax.config.update("jax_enable_x64", True)
+    try:
+        cfg = LBFGSConfig(
+            max_iter=4, history_size=5, line_search=True, batch_mode=batch_mode
+        )
+        scales = jnp.asarray([1.0, 50.0, 0.02, 7.0], dtype)
+        mats = []
+        rhs = []
+        for s in range(4):
+            rng = np.random.RandomState(s)
+            m = rng.randn(10, 10)
+            mats.append(m @ m.T + (10.0 ** (s - 1)) * np.eye(10))
+            rhs.append(rng.randn(10))
+        a_all = jnp.asarray(np.stack(mats), dtype)
+        b_all = jnp.asarray(np.stack(rhs), dtype)
+
+        def loss_k(x, a, b, scale):
+            return scale * (0.5 * x @ (a @ x) - b @ x)
+
+        x0 = jnp.ones((4, 10), dtype)
+
+        def one(x, a, b, scale):
+            state = lbfgs_init(x, cfg)
+            return lbfgs_step(
+                lambda xx: loss_k(xx, a, b, scale), x, state, cfg
+            )[0]
+
+        batched = jax.vmap(one)(x0, a_all, b_all, scales)
+        for k in range(4):
+            xk = one(x0[k], a_all[k], b_all[k], scales[k])
+            np.testing.assert_allclose(
+                np.asarray(batched[k]), np.asarray(xk), rtol=1e-4, atol=1e-5,
+                err_msg=f"client {k} diverges between vmapped and sequential",
+            )
+    finally:
+        if not batch_mode:
+            jax.config.update("jax_enable_x64", False)
 
 
 def test_zero_gradient_early_exit():
