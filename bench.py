@@ -1,0 +1,102 @@
+"""Benchmark: flagship 3-client ResNet18 FedAvg hot loop on real hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+
+The hot loop is the jitted sharded epoch function — every client's
+stochastic L-BFGS step (up to 4 inner iterations, Armijo line-search
+probes included) on one lockstep minibatch per client. This is the same
+work the reference does in `opt.step(closure)` x3 per minibatch
+(reference src/federated_trio_resnet.py:320-338).
+
+`vs_baseline` compares against the reference's measured throughput on this
+host (torch CPU — the reference has no device code; see
+`benchmarks/measure_reference.py`, result cached in
+`benchmarks/reference_throughput.json`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    bench_device = os.environ.get("BENCH_DEVICE", "")
+    if bench_device == "cpu":
+        import jax
+        from jax._src import xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    k = 3
+    batch = 32
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    # synthetic CIFAR-shaped data (identical compute to the real archive)
+    src = synthetic_cifar(n_train=k * batch * max(steps, 8), n_test=64)
+    cfg = get_preset(
+        "fedavg_resnet",
+        n_clients=k,
+        batch=batch,
+        check_results=False,
+    )
+    tr = Trainer(cfg, verbose=False, source=src)
+    gid = tr.group_order[0]
+    epoch_fn, _, init_fn = tr._fns(gid)
+    lstate, y, z, rho, extra = init_fn(tr.flat)
+
+    def run_epoch(idx):
+        return epoch_fn(
+            tr.flat, lstate, tr.stats, tr.shard_imgs, tr.shard_labels,
+            idx, tr.mean, tr.std, y, z, rho,
+        )
+
+    idx = tr._epoch_indices(0, gid, 0, 0)[:steps]
+    # warmup / compile
+    out = run_epoch(idx[:2])
+    jax.block_until_ready(out[0])
+
+    t0 = time.perf_counter()
+    out = run_epoch(idx)
+    jax.block_until_ready(out[0])
+    dt = time.perf_counter() - t0
+
+    n_samples = steps * k * batch
+    sps = n_samples / dt
+
+    ref_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "reference_throughput.json",
+    )
+    vs_baseline = None
+    if os.path.exists(ref_path):
+        with open(ref_path) as f:
+            ref = json.load(f)
+        ref_sps = ref.get("samples_per_sec")
+        if ref_sps:
+            vs_baseline = sps / ref_sps
+
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_resnet18_3client_lbfgs_train_throughput",
+                "value": round(sps, 2),
+                "unit": "samples/sec",
+                "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Measure the reference implementation's training throughput on this host.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is
+measured: the reference's own `LBFGSNew` optimizer (imported from
+/root/reference/src at runtime — nothing is copied) driving 3 sequential
+torch CNN clients exactly as its drivers do (one `opt.step(closure)` per
+client per lockstep minibatch, reference
+src/federated_trio_resnet.py:320-338), on the same workload bench.py runs
+(ResNet18-class model, batch 32, CIFAR-shaped synthetic data, CPU — the
+reference has no device-placement code, SURVEY.md §0).
+
+Writes benchmarks/reference_throughput.json, consumed by bench.py's
+`vs_baseline`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+sys.path.insert(0, "/root/reference/src")
+from lbfgsnew import LBFGSNew  # noqa: E402  (reference optimizer, not copied)
+
+
+class _Block(nn.Module):
+    """Standard CIFAR BasicBlock (3x3 conv x2 + BN, ELU, 1x1 shortcut)."""
+
+    def __init__(self, in_planes, planes, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_planes, planes, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.short = nn.Sequential()
+        if stride != 1 or in_planes != planes:
+            self.short = nn.Sequential(
+                nn.Conv2d(in_planes, planes, 1, stride, bias=False),
+                nn.BatchNorm2d(planes),
+            )
+
+    def forward(self, x):
+        out = F.elu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return F.elu(out + self.short(x))
+
+
+class _ResNet18(nn.Module):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        layers = []
+        in_planes = 64
+        for planes, stride in [
+            (64, 1), (64, 1), (128, 2), (128, 1),
+            (256, 2), (256, 1), (512, 2), (512, 1),
+        ]:
+            layers.append(_Block(in_planes, planes, stride))
+            in_planes = planes
+        self.blocks = nn.Sequential(*layers)
+        self.linear = nn.Linear(512, num_classes)
+
+    def forward(self, x):
+        out = F.elu(self.bn1(self.conv1(x)))
+        out = self.blocks(out)
+        out = F.avg_pool2d(out, 4).flatten(1)
+        return self.linear(out)
+
+
+def main() -> None:
+    torch.manual_seed(0)
+    k, batch = 3, 32
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    nets = [_ResNet18() for _ in range(k)]
+    opts = [
+        LBFGSNew(
+            n.parameters(),
+            history_size=10,
+            max_iter=4,
+            line_search_fn=True,
+            batch_mode=True,
+        )
+        for n in nets
+    ]
+    crit = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    data = torch.from_numpy(
+        rng.normal(0, 1, (steps, k, batch, 3, 32, 32)).astype(np.float32)
+    )
+    labels = torch.from_numpy(
+        rng.integers(0, 10, (steps, k, batch)).astype(np.int64)
+    )
+
+    def one_step(s):
+        for c in range(k):
+            x, y = data[s, c], labels[s, c]
+
+            def closure():
+                if torch.is_grad_enabled():
+                    opts[c].zero_grad()
+                loss = crit(nets[c](x), y)
+                if loss.requires_grad:
+                    loss.backward()
+                return loss
+
+            opts[c].step(closure)
+
+    one_step(0)  # warmup
+    t0 = time.perf_counter()
+    for s in range(steps):
+        one_step(s)
+    dt = time.perf_counter() - t0
+
+    sps = steps * k * batch / dt
+    out = {
+        "samples_per_sec": round(sps, 2),
+        "sec_per_lockstep_minibatch": round(dt / steps, 3),
+        "workload": "3-client ResNet18-class CIFAR shapes, batch 32, "
+        "LBFGSNew(history=10, max_iter=4, line_search, batch_mode), torch CPU",
+        "host": os.uname().nodename,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "reference_throughput.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

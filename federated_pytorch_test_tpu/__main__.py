@@ -1,0 +1,94 @@
+"""CLI for the framework: `python -m federated_pytorch_test_tpu`.
+
+The reference has no CLI at all — experiments are run by executing one of
+the five driver scripts after hand-editing its module constants (reference
+src/federated_trio.py:17-34; SURVEY.md §5 config system). Here the five
+scripts are presets and every constant is a flag:
+
+    python -m federated_pytorch_test_tpu --preset fedavg
+    python -m federated_pytorch_test_tpu --preset admm --nloop 2 --no-bb-update
+    python -m federated_pytorch_test_tpu --list-presets
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from federated_pytorch_test_tpu.engine import (
+    PRESETS,
+    ExperimentConfig,
+    get_preset,
+    run_experiment,
+)
+
+
+def _add_config_flags(parser: argparse.ArgumentParser) -> None:
+    """One flag per `ExperimentConfig` field (booleans get --x/--no-x)."""
+    for f in dataclasses.fields(ExperimentConfig):
+        flag = "--" + f.name.replace("_", "-")
+        if f.type in ("bool", bool):
+            parser.add_argument(
+                flag,
+                dest=f.name,
+                action=argparse.BooleanOptionalAction,
+                default=None,
+            )
+        else:
+            typ = {"int": int, "float": float}.get(str(f.type), str)
+            if "int | None" in str(f.type) or "str | None" in str(f.type):
+                typ = str
+            parser.add_argument(flag, dest=f.name, type=typ, default=None)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="federated_pytorch_test_tpu",
+        description="TPU-native federated / consensus optimization experiments",
+    )
+    parser.add_argument(
+        "--preset",
+        default="fedavg",
+        choices=sorted(PRESETS),
+        help="base experiment (one of the five reference drivers)",
+    )
+    parser.add_argument("--list-presets", action="store_true")
+    parser.add_argument(
+        "--metrics-out", default=None, help="write metric series JSON here"
+    )
+    parser.add_argument("--quiet", action="store_true")
+    _add_config_flags(parser)
+    args = parser.parse_args(argv)
+
+    if args.list_presets:
+        for name, cfg in sorted(PRESETS.items()):
+            print(
+                f"{name:16s} model={cfg.model:9s} strategy={cfg.strategy:7s} "
+                f"batch={cfg.batch} nloop={cfg.nloop} nadmm={cfg.nadmm}"
+            )
+        return 0
+
+    overrides = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(ExperimentConfig)
+        if getattr(args, f.name) is not None
+    }
+    for key in ("max_devices",):
+        if key in overrides:
+            overrides[key] = int(overrides[key])
+    cfg = get_preset(args.preset, **overrides)
+    print(f"# running preset={args.preset} cfg={cfg}")
+    recorder = run_experiment(cfg, verbose=not args.quiet)
+    if args.metrics_out:
+        recorder.save(args.metrics_out)
+        print(f"# metrics written to {args.metrics_out}")
+    final = recorder.latest("test_accuracy")
+    if final is not None:
+        print("# final per-client accuracy: " + json.dumps(final))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""The training engine: configs, sharded step builders, and the driver.
+
+Collapses the reference's five near-clone driver scripts (SURVEY.md §1)
+into one `Trainer` over pluggable consensus strategies, with the hot loops
+compiled as sharded XLA programs (see `steps.py`).
+"""
+
+from federated_pytorch_test_tpu.engine.config import (
+    PRESETS,
+    ExperimentConfig,
+    get_preset,
+)
+from federated_pytorch_test_tpu.engine.trainer import Trainer, run_experiment
+
+__all__ = [
+    "ExperimentConfig",
+    "PRESETS",
+    "Trainer",
+    "get_preset",
+    "run_experiment",
+]

@@ -1,0 +1,169 @@
+"""Experiment configuration: one dataclass, five reference presets.
+
+The reference's "config system" is a block of module-level constants at the
+top of each driver script (reference src/federated_trio.py:17-34,
+src/consensus_admm_trio.py:16-44, src/no_consensus_trio.py:10-25) edited by
+hand; each of the five scripts is one experiment. Here those exact knobs
+are fields of `ExperimentConfig`, and the five scripts become the five
+entries of `PRESETS`. A real CLI lives in
+`federated_pytorch_test_tpu.__main__`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from federated_pytorch_test_tpu.consensus import ADMMConfig
+from federated_pytorch_test_tpu.optim import LBFGSConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """All knobs of the five reference drivers, in one place.
+
+    Defaults follow the FedAvg simple-CNN driver
+    (reference src/federated_trio.py:17-34).
+    """
+
+    name: str = "custom"
+    model: str = "net"  # net | net1 | net2 | resnet18 (models.MODELS)
+    dataset: str = "cifar10"  # cifar10 | cifar100
+    data_root: str | None = None  # None => $CIFAR_DATA_DIR or ./torchdata
+    synthetic_ok: bool = True  # fall back to synthetic data if no archive
+
+    n_clients: int = 3
+    batch: int = 512  # reference `default_batch`
+    strategy: str = "fedavg"  # none | fedavg | admm
+
+    # loop nest sizes (reference src/federated_trio.py:20-22)
+    nloop: int = 12  # outer loops over the partition groups
+    nepoch: int = 1  # epochs per averaging round
+    nadmm: int = 3  # averaging / ADMM rounds per partition group
+
+    # regularization (reference src/federated_trio.py:25-26)
+    lambda1: float = 1e-4
+    lambda2: float = 1e-4
+    # 'active_linear': elastic net on the active group's coordinates when
+    #   that group is a linear layer (reference src/federated_trio.py:309-310);
+    # 'first_linear': elastic net on the FIRST linear group's coordinates of
+    #   the full vector — the no_consensus driver's behavior, where the
+    #   `or`-quirk makes `linear_layer_parameters()` return only fc1
+    #   (reference src/simple_models.py:34,74, src/no_consensus_trio.py:195-196);
+    # 'none': no regularization (the resnet drivers' closures).
+    reg_mode: str = "active_linear"
+
+    biased_input: bool = True  # per-client normalization (reference :31-34)
+
+    # inner optimizer (reference src/federated_trio.py:273-275)
+    lbfgs_history: int = 10
+    lbfgs_max_iter: int = 4
+    lbfgs_lr: float = 1.0
+
+    # ADMM (reference src/consensus_admm_trio.py:23,37-44)
+    admm_rho0: float = 1e-3
+    bb_update: bool = False
+    bb_period: int = 2
+    bb_alphacorrmin: float = 0.2
+    bb_epsilon: float = 1e-3
+    bb_rhomax: float = 0.1
+
+    # flags (reference src/federated_trio.py:28-31)
+    init_model: bool = True  # common-seed init across clients
+    load_model: bool = False
+    save_model: bool = False
+    check_results: bool = True  # eval after each averaging round
+    average_model: bool = False  # one-shot whole-model mean at start
+    #   (reference src/no_consensus_trio.py:22,134-160)
+
+    # resnet drivers shuffle the block visit order once with np.seed(0)
+    # (reference src/federated_trio_resnet.py:296-297)
+    shuffle_group_order: bool = False
+
+    seed: int = 0
+    eval_batch: int = 500
+    checkpoint_dir: str = "./checkpoints"
+    max_devices: int | None = None
+
+    def lbfgs_config(self) -> LBFGSConfig:
+        return LBFGSConfig(
+            lr=self.lbfgs_lr,
+            max_iter=self.lbfgs_max_iter,
+            history_size=self.lbfgs_history,
+            line_search=True,
+            batch_mode=True,
+        )
+
+    def admm_config(self) -> ADMMConfig:
+        return ADMMConfig(
+            rho0=self.admm_rho0,
+            bb_update=self.bb_update,
+            bb_period=self.bb_period,
+            bb_alphacorrmin=self.bb_alphacorrmin,
+            bb_epsilon=self.bb_epsilon,
+            bb_rhomax=self.bb_rhomax,
+        )
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# The five reference driver scripts as presets. Loop sizes, batch sizes,
+# rho, and flags are each script's module constants (citations per field
+# above; per-preset deltas cited inline).
+PRESETS = {
+    # reference src/no_consensus_trio.py: Net1, batch 32, 12 epochs of
+    # independent training, fc-only elastic net, eval per round.
+    "no_consensus": ExperimentConfig(
+        name="no_consensus",
+        model="net1",
+        batch=32,
+        strategy="none",
+        nloop=1,
+        nepoch=12,
+        nadmm=1,
+        reg_mode="first_linear",
+        init_model=False,  # reference src/no_consensus_trio.py:19
+    ),
+    # reference src/federated_trio.py: Net, batch 512, Nloop=12, Nadmm=3.
+    "fedavg": ExperimentConfig(name="fedavg", model="net", strategy="fedavg"),
+    # reference src/federated_trio_resnet.py: ResNet18, batch 32, Nadmm=3,
+    # no regularization, shuffled block order.
+    "fedavg_resnet": ExperimentConfig(
+        name="fedavg_resnet",
+        model="resnet18",
+        batch=32,
+        strategy="fedavg",
+        reg_mode="none",
+        shuffle_group_order=True,
+    ),
+    # reference src/consensus_admm_trio.py: Net, batch 512, Nadmm=5,
+    # rho0=1e-3 with BB adaptation on.
+    "admm": ExperimentConfig(
+        name="admm",
+        model="net",
+        strategy="admm",
+        nadmm=5,
+        bb_update=True,
+    ),
+    # reference src/consensus_admm_trio_resnet.py: ResNet18, batch 32,
+    # Nadmm=3, fixed scalar rho=0.001 (:333), no BB, shuffled block order.
+    "admm_resnet": ExperimentConfig(
+        name="admm_resnet",
+        model="resnet18",
+        batch=32,
+        strategy="admm",
+        nadmm=3,
+        reg_mode="none",
+        bb_update=False,
+        shuffle_group_order=True,
+    ),
+}
+
+
+def get_preset(name: str, **overrides) -> ExperimentConfig:
+    """Fetch a preset by name, optionally overriding fields."""
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return cfg.replace(**overrides) if overrides else cfg

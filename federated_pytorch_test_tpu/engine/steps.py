@@ -1,0 +1,312 @@
+"""Sharded, jitted step builders: the hot loops of every experiment.
+
+The reference's hot loop is Python: for each minibatch it builds three
+closures and steps three optimizers sequentially in one process
+(reference src/federated_trio.py:285-338). Here ONE jitted function per
+(model, partition-group) runs a whole epoch for ALL clients:
+
+* `shard_map` over the `clients` mesh axis — each device holds a local
+  block of K/D clients (their params, optimizer state, data shard);
+* `vmap` over the local block — every client's L-BFGS step (line-search
+  probes included) is batched into single XLA ops;
+* `lax.scan` over the epoch's minibatches — the per-step index gather
+  happens on device from the resident uint8 shard, so a full epoch is one
+  device computation with zero host round-trips.
+
+The consensus exchange stays OUTSIDE the epoch function (it runs once per
+averaging round, reference src/federated_trio.py:353-363) and is its own
+tiny jitted collective; only the active group's coordinates cross the
+interconnect (reference README.md:2's bandwidth contract).
+
+BatchNorm models thread a `batch_stats` collection through the scan.
+Deliberate deviation (SURVEY.md §7 hard part 5): the reference mutates
+running stats at EVERY closure evaluation inside the line search; here
+stats update once per optimizer step, from the diagnostic forward pass at
+the accepted parameters (the same forward the reference runs for its
+per-batch loss print, reference src/federated_trio.py:341-352). Stats stay
+client-local and are never averaged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from federated_pytorch_test_tpu.consensus import (
+    ADMMConfig,
+    ADMMState,
+    FedAvgState,
+    admm_init,
+    admm_penalty,
+    admm_round,
+    elastic_net,
+    fedavg_init,
+    fedavg_round,
+)
+from federated_pytorch_test_tpu.data import normalize
+from federated_pytorch_test_tpu.optim import LBFGSConfig, lbfgs_init, lbfgs_step
+from federated_pytorch_test_tpu.parallel import CLIENT_AXIS
+from federated_pytorch_test_tpu.partition import Partition
+
+PyTree = Any
+
+
+class GroupContext(NamedTuple):
+    """Everything static a group's step functions close over."""
+
+    model: Any  # flax module
+    unravel: Callable[[jnp.ndarray], PyTree]  # flat [N] -> params tree
+    partition: Partition  # the TRAINING partition (may be the trivial one)
+    gid: int
+    has_stats: bool  # model carries a batch_stats collection
+    lbfgs: LBFGSConfig
+    strategy: str  # none | fedavg | admm
+    admm: ADMMConfig
+    # elastic-net on the active group's coordinates (reg_mode active_linear,
+    # reference src/federated_trio.py:309-310)
+    reg_on_active: bool
+    # elastic-net on fixed segments of the FULL flat vector (reg_mode
+    # first_linear, the no_consensus fc1 quirk, reference
+    # src/no_consensus_trio.py:195-196 + src/simple_models.py:34)
+    reg_segments: Tuple = ()
+    lambda1: float = 1e-4
+    lambda2: float = 1e-4
+
+
+def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
+    """One client's CE loss (+ updated batch stats) at full flat params."""
+    params = ctx.unravel(flat)
+    if ctx.has_stats:
+        variables = {"params": params, "batch_stats": stats}
+        logits, updated = ctx.model.apply(
+            variables, images, train=True, mutable=["batch_stats"]
+        )
+        new_stats = updated["batch_stats"]
+    else:
+        logits = ctx.model.apply({"params": params}, images, train=True)
+        new_stats = stats
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    return loss, new_stats
+
+
+def _regularizer(ctx: GroupContext, x: jnp.ndarray, flat: jnp.ndarray):
+    """Elastic-net term for one client (reference src/federated_trio.py:303-333)."""
+    reg = jnp.asarray(0.0, x.dtype)
+    if ctx.reg_on_active:
+        reg = reg + elastic_net(x, ctx.lambda1, ctx.lambda2)
+    if ctx.reg_segments:
+        parts = [
+            lax.slice(flat, (s.start,), (s.start + s.size,))
+            for s in ctx.reg_segments
+        ]
+        v = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        reg = reg + elastic_net(v, ctx.lambda1, ctx.lambda2)
+    return reg
+
+
+def _client_train_step(ctx: GroupContext):
+    """One client's optimizer step on the active group's coordinates.
+
+    Equivalent of one `opt_k.step(closure_k)` + the diagnostic forward
+    (reference src/federated_trio.py:304-352), as a pure function.
+    """
+
+    def step(flat, lstate, stats, images_u8, labels, mean, std, y, z, rho):
+        images = normalize(images_u8, mean, std)
+
+        def loss_fn(x):
+            full = ctx.partition.insert(flat, ctx.gid, x)
+            loss, _ = _data_loss(ctx, full, stats, images, labels)
+            loss = loss + _regularizer(ctx, x, full)
+            if ctx.strategy == "admm":
+                loss = loss + admm_penalty(x, y, z, rho)
+            return loss
+
+        x0 = ctx.partition.extract(flat, ctx.gid)
+        x1, lstate, aux = lbfgs_step(loss_fn, x0, lstate, ctx.lbfgs)
+        flat = ctx.partition.insert(flat, ctx.gid, x1)
+        # diagnostic forward at the accepted params: per-batch loss print
+        # (reference src/federated_trio.py:341-352) + batch-stats refresh
+        diag_loss, stats = _data_loss(ctx, flat, stats, images, labels)
+        return flat, lstate, stats, diag_loss
+
+    return step
+
+
+def build_epoch_fn(ctx: GroupContext, mesh):
+    """Jitted epoch: scan over minibatches, vmap over local clients.
+
+    Signature:
+      (flat [K,N], lstate, stats, shard_imgs [K,n,H,W,C] u8,
+       shard_labels [K,n], idx [S,K,B], mean [K], std [K],
+       y [K,G], z [G], rho [K,1])
+      -> (flat, lstate, stats, losses [S,K])
+
+    For non-ADMM strategies `y/z/rho` are zero-size placeholders (static
+    python `None` is avoided so one signature serves all strategies).
+    """
+    client_step = _client_train_step(ctx)
+
+    def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std, y, z, rho):
+        def body(carry, idx_t):
+            flat, lstate, stats = carry
+            images = jnp.take_along_axis(
+                shard_imgs, idx_t[:, :, None, None, None], axis=1
+            )
+            labels = jnp.take_along_axis(shard_labels, idx_t, axis=1)
+            flat, lstate, stats, losses = jax.vmap(
+                client_step,
+                in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, 0),
+            )(flat, lstate, stats, images, labels, mean, std, y, z, rho)
+            return (flat, lstate, stats), losses
+
+        (flat, lstate, stats), losses = lax.scan(
+            body, (flat, lstate, stats), idx
+        )
+        return flat, lstate, stats, losses
+
+    c = P(CLIENT_AXIS)
+    r = P()
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(c, c, c, c, c, P(None, CLIENT_AXIS), c, c, c, r, c),
+        out_specs=(c, c, c, P(None, CLIENT_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def build_round_init_fn(ctx: GroupContext, mesh):
+    """Fresh per-group optimizer + consensus state from current params.
+
+    The reference creates a fresh `LBFGSNew` per partition round
+    (reference src/federated_trio.py:273-275) and zeroed y/z per group
+    (reference src/consensus_admm_trio.py:281-288).
+    """
+
+    def local(flat):
+        x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
+        lstate = jax.vmap(lambda xg: lbfgs_init(xg, ctx.lbfgs))(x)
+        if ctx.strategy == "admm":
+            cstate = admm_init(x, ctx.admm)
+            y, z, rho = cstate.y, cstate.z, cstate.rho
+            extra = (cstate.yhat0, cstate.x0)
+        else:
+            g = ctx.partition.group_size(ctx.gid)
+            z = fedavg_init(g, x.dtype).z
+            y = jnp.zeros((x.shape[0], 0), x.dtype)  # placeholders
+            rho = jnp.zeros((x.shape[0], 0), x.dtype)
+            extra = (y, y)
+        return lstate, y, z, rho, extra
+
+    c = P(CLIENT_AXIS)
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(c,),
+        out_specs=(c, c, P(), c, (c, c)),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def build_consensus_fn(ctx: GroupContext, mesh):
+    """Jitted averaging/ADMM round over the active group's coordinates.
+
+    FedAvg: z = mean_k x_k, broadcast back into every client's params
+    (reference src/federated_trio.py:353-363). ADMM: BB-rho (if due),
+    weighted z-update, y-update; clients keep their own x (reference
+    src/consensus_admm_trio.py:395-513).
+    """
+    if ctx.strategy == "none":
+        return None
+
+    if ctx.strategy == "fedavg":
+
+        def local(flat, y, z, rho, extra, nadmm):
+            x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
+            state, met = fedavg_round(x, FedAvgState(z=z))
+            flat = jax.vmap(
+                lambda f: ctx.partition.insert(f, ctx.gid, state.z)
+            )(flat)
+            zeros = jnp.zeros((), x.dtype)
+            return flat, y, state.z, rho, extra, (
+                met["dual_residual"],
+                zeros,
+                zeros,
+            )
+
+    else:  # admm
+
+        def local(flat, y, z, rho, extra, nadmm):
+            x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
+            yhat0, x0 = extra
+            state = ADMMState(y=y, z=z, rho=rho, yhat0=yhat0, x0=x0)
+            state, met = admm_round(x, state, nadmm, ctx.admm)
+            return flat, state.y, state.z, state.rho, (state.yhat0, state.x0), (
+                met.dual_residual,
+                met.primal_residual,
+                met.mean_rho,
+            )
+
+    c = P(CLIENT_AXIS)
+    r = P()
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(c, c, r, c, (c, c), r),
+        out_specs=(c, c, r, c, (c, c), (r, r, r)),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def build_eval_fn(model, unravel, has_stats: bool, mesh):
+    """Jitted full-test-set evaluation for every client.
+
+    The reference's `verification_error_check` iterates each client's
+    testloader in Python (reference src/federated_trio.py:199-223); here
+    one call scans the whole padded `[T,B,...]` test set on device for all
+    clients and returns `[K]` correct counts (top-1).
+    """
+
+    def client_eval(flat, stats, test_imgs, test_labels, test_mask, mean, std):
+        params = unravel(flat)
+        variables = {"params": params}
+        if has_stats:
+            variables["batch_stats"] = stats
+
+        def body(correct, batch):
+            img, lab, msk = batch
+            logits = model.apply(variables, normalize(img, mean, std), train=False)
+            pred = jnp.argmax(logits, axis=-1)
+            return correct + jnp.sum((pred == lab) & msk), None
+
+        correct, _ = lax.scan(
+            body, jnp.int32(0), (test_imgs, test_labels, test_mask)
+        )
+        return correct
+
+    def local(flat, stats, test_imgs, test_labels, test_mask, mean, std):
+        # the client-sharded out-spec assembles local [K_loc] blocks into
+        # the global [K] — no gather collective needed
+        return jax.vmap(
+            client_eval, in_axes=(0, 0, None, None, None, 0, 0)
+        )(flat, stats, test_imgs, test_labels, test_mask, mean, std)
+
+    c = P(CLIENT_AXIS)
+    r = P()
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(c, c, r, r, r, c, c),
+        out_specs=c,
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,342 @@
+"""The experiment driver: partition rounds, consensus, eval, checkpointing.
+
+One `Trainer` replaces all five reference driver scripts (SURVEY.md §1:
+they are near-clones differing only in model, loop sizes, and which
+coordination algorithm is inlined). The loop nest is the reference's
+`Nloop { groups { Nadmm { epochs { batches } } } }`
+(reference src/federated_trio.py:11-14,256-285), but each `{batches}` body
+is ONE jitted sharded epoch call and each consensus exchange is one jitted
+collective (see `engine/steps.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from federated_pytorch_test_tpu.data import load_cifar, make_federated
+from federated_pytorch_test_tpu.engine.config import ExperimentConfig
+from federated_pytorch_test_tpu.engine.steps import (
+    GroupContext,
+    build_consensus_fn,
+    build_epoch_fn,
+    build_eval_fn,
+    build_round_init_fn,
+)
+from federated_pytorch_test_tpu.models import MODELS
+from federated_pytorch_test_tpu.parallel import (
+    client_sharding,
+    largest_feasible_mesh,
+    replicated_sharding,
+)
+from federated_pytorch_test_tpu.partition import (
+    Partition,
+    Segment,
+    flatten_params,
+)
+from federated_pytorch_test_tpu.utils import (
+    MetricsRecorder,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+PyTree = Any
+
+
+def _epoch_seed(base: int, *parts: int) -> np.random.Generator:
+    return np.random.default_rng([base & 0x7FFFFFFF, *[p & 0x7FFFFFFF for p in parts]])
+
+
+class Trainer:
+    """Builds all device state and step functions for one experiment."""
+
+    def __init__(self, cfg: ExperimentConfig, verbose: bool = True, source=None):
+        self.cfg = cfg
+        self.recorder = MetricsRecorder(verbose=verbose)
+
+        if source is None:
+            source = load_cifar(
+                cfg.dataset, cfg.data_root, synthetic_ok=cfg.synthetic_ok
+            )
+        self.fed = make_federated(source, cfg.n_clients, biased=cfg.biased_input)
+        self.mesh = largest_feasible_mesh(cfg.n_clients, cfg.max_devices)
+
+        model_cls = MODELS[cfg.model]
+        self.model = (
+            model_cls(num_classes=self.fed.num_classes)
+            if "num_classes" in getattr(model_cls, "__dataclass_fields__", {})
+            else model_cls()
+        )
+
+        variables = self._init_variables()
+        params_t = jax.tree.map(lambda x: x[0], variables["params"])
+        flat0, self.unravel = flatten_params(params_t)
+        self.n_params = int(flat0.shape[0])
+        flat = jax.vmap(lambda p: flatten_params(p)[0])(variables["params"])
+        self.has_stats = "batch_stats" in variables
+        stats = variables.get("batch_stats", {})
+
+        # model partition (layer/block groups + metadata)
+        self.model_partition = self.model.partition(params_t)
+        # training partition: the trivial whole-vector group for independent
+        # training (reference src/no_consensus_trio.py trains the full model)
+        if cfg.strategy == "none":
+            self.partition = Partition(
+                groups=((Segment(0, self.n_params),),), total=self.n_params
+            )
+            self.group_order = [0]
+        else:
+            self.partition = self.model_partition
+            order = list(
+                self.model_partition.train_order
+                or range(self.model_partition.num_groups)
+            )
+            if cfg.shuffle_group_order:
+                # reference src/federated_trio_resnet.py:296-297: one fixed
+                # np.seed(0) permutation, reused for every outer loop
+                rng = np.random.RandomState(0)
+                order = list(rng.permutation(self.model_partition.num_groups))
+            self.group_order = [int(g) for g in order]
+
+        # device placement
+        csh = client_sharding(self.mesh)
+        rsh = replicated_sharding(self.mesh)
+        self.flat = jax.device_put(flat, csh)
+        self.stats = jax.tree.map(lambda x: jax.device_put(x, csh), stats)
+        self.shard_imgs = jax.device_put(jnp.asarray(self.fed.train_images), csh)
+        self.shard_labels = jax.device_put(jnp.asarray(self.fed.train_labels), csh)
+        self.mean = jax.device_put(jnp.asarray(self.fed.mean), csh)
+        self.std = jax.device_put(jnp.asarray(self.fed.std), csh)
+        t_imgs, t_labels, t_mask = self._stack_test()
+        self.test_imgs = jax.device_put(t_imgs, rsh)
+        self.test_labels = jax.device_put(t_labels, rsh)
+        self.test_mask = jax.device_put(t_mask, rsh)
+
+        # per-group jitted functions, built lazily and cached
+        self._epoch_fns: Dict[int, Any] = {}
+        self._consensus_fns: Dict[int, Any] = {}
+        self._init_fns: Dict[int, Any] = {}
+        self._eval_fn = None
+        self._completed_nloops = 0
+
+        if cfg.load_model:
+            self._restore()
+        if cfg.average_model:
+            # one-shot whole-model average before training
+            # (reference src/no_consensus_trio.py:22,134-160)
+            self.flat = jax.device_put(
+                jnp.broadcast_to(
+                    jnp.mean(self.flat, axis=0), self.flat.shape
+                ).copy(),
+                csh,
+            )
+
+    # ---------------------------------------------------------------- setup
+
+    def _init_variables(self) -> PyTree:
+        """Stacked client variables.
+
+        `init_model=True`: all clients identical (common-seed Xavier init,
+        reference src/federated_trio.py:229-236). Otherwise each client gets
+        its own draw (the reference's three independently-constructed nets,
+        reference src/no_consensus_trio.py:114-116).
+        """
+        cfg = self.cfg
+        dummy = jnp.zeros((1,) + tuple(self.model.input_shape()), jnp.float32)
+        if cfg.init_model:
+            v = self.model.init(jax.random.PRNGKey(cfg.seed), dummy, train=False)
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (cfg.n_clients,) + x.shape),
+                v,
+            )
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), cfg.n_clients)
+        vs = [self.model.init(k, dummy, train=False) for k in keys]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *vs)
+
+    def _stack_test(self):
+        """Pad + stack the test sweep as [T,B,...] arrays for the eval scan."""
+        b = self.cfg.eval_batch
+        imgs, labels, masks = [], [], []
+        for i, l, m in self.fed.test_batches(b):
+            imgs.append(i)
+            labels.append(l)
+            masks.append(m)
+        return (
+            jnp.asarray(np.stack(imgs)),
+            jnp.asarray(np.stack(labels)),
+            jnp.asarray(np.stack(masks)),
+        )
+
+    def _ctx(self, gid: int) -> GroupContext:
+        cfg = self.cfg
+        reg_on_active = (
+            cfg.reg_mode == "active_linear"
+            and gid in self.partition.linear_group_ids
+        )
+        reg_segments = ()
+        if cfg.reg_mode == "first_linear" and self.model_partition.linear_group_ids:
+            first = self.model_partition.linear_group_ids[0]
+            reg_segments = self.model_partition.groups[first]
+        return GroupContext(
+            model=self.model,
+            unravel=self.unravel,
+            partition=self.partition,
+            gid=gid,
+            has_stats=self.has_stats,
+            lbfgs=cfg.lbfgs_config(),
+            strategy=cfg.strategy,
+            admm=cfg.admm_config(),
+            reg_on_active=reg_on_active,
+            reg_segments=reg_segments,
+            lambda1=cfg.lambda1,
+            lambda2=cfg.lambda2,
+        )
+
+    def _fns(self, gid: int):
+        if gid not in self._epoch_fns:
+            ctx = self._ctx(gid)
+            self._epoch_fns[gid] = build_epoch_fn(ctx, self.mesh)
+            self._consensus_fns[gid] = build_consensus_fn(ctx, self.mesh)
+            self._init_fns[gid] = build_round_init_fn(ctx, self.mesh)
+        return self._epoch_fns[gid], self._consensus_fns[gid], self._init_fns[gid]
+
+    @property
+    def eval_fn(self):
+        if self._eval_fn is None:
+            self._eval_fn = build_eval_fn(
+                self.model, self.unravel, self.has_stats, self.mesh
+            )
+        return self._eval_fn
+
+    # ------------------------------------------------------------- training
+
+    def _epoch_indices(self, *loop_ids: int) -> jnp.ndarray:
+        """Per-client shuffled lockstep batch indices `[S, K, B]`.
+
+        The `SubsetRandomSampler` equivalent (reference
+        src/no_consensus_trio.py:59-61): each client reshuffles its own
+        shard each epoch, deterministically in (seed, loop ids).
+        """
+        k, n = self.cfg.n_clients, self.fed.shard_size
+        b = self.cfg.batch
+        s = n // b
+        rng = _epoch_seed(self.cfg.seed + 69, *loop_ids)
+        perms = np.stack([rng.permutation(n) for _ in range(k)])  # [K, n]
+        idx = perms[:, : s * b].reshape(k, s, b).transpose(1, 0, 2)  # [S,K,B]
+        return jnp.asarray(idx, jnp.int32)
+
+    def evaluate(self) -> np.ndarray:
+        """Per-client top-1 accuracy over the full test set."""
+        correct = self.eval_fn(
+            self.flat,
+            self.stats,
+            self.test_imgs,
+            self.test_labels,
+            self.test_mask,
+            self.mean,
+            self.std,
+        )
+        total = int(np.asarray(self.test_mask).sum())
+        return np.asarray(correct) / total
+
+    def run_round(self, nloop: int, gid: int) -> None:
+        """One partition group's full round: init, Nadmm x (epochs + consensus)."""
+        cfg = self.cfg
+        epoch_fn, consensus_fn, init_fn = self._fns(gid)
+        lstate, y, z, rho, extra = init_fn(self.flat)
+        gsize = self.partition.group_size(gid)
+
+        for nadmm in range(cfg.nadmm):
+            for epoch in range(cfg.nepoch):
+                idx = self._epoch_indices(nloop, gid, nadmm, epoch)
+                self.flat, lstate, self.stats, losses = epoch_fn(
+                    self.flat,
+                    lstate,
+                    self.stats,
+                    self.shard_imgs,
+                    self.shard_labels,
+                    idx,
+                    self.mean,
+                    self.std,
+                    y,
+                    z,
+                    rho,
+                )
+                losses = np.asarray(losses)  # [S, K]
+                for s in range(losses.shape[0]):
+                    self.recorder.batch_losses(
+                        losses[s],
+                        nloop=nloop,
+                        group=gid,
+                        nadmm=nadmm,
+                        epoch=epoch,
+                        minibatch=s,
+                    )
+                if cfg.strategy == "none" and cfg.check_results:
+                    # independent training has no consensus round; eval per
+                    # epoch (the reference evals per batch,
+                    # src/no_consensus_trio.py:266-267 — per-epoch is the
+                    # tractable equivalent cadence)
+                    self.recorder.accuracies(
+                        self.evaluate(), nloop=nloop, group=gid, nadmm=epoch
+                    )
+            if consensus_fn is not None:
+                self.flat, y, z, rho, extra, met = consensus_fn(
+                    self.flat, y, z, rho, extra, jnp.int32(nadmm)
+                )
+                dual, primal, mean_rho = (np.asarray(m) for m in met)
+                is_admm = cfg.strategy == "admm"
+                self.recorder.residuals(
+                    primal if is_admm else None,
+                    dual,
+                    mean_rho if is_admm else None,
+                    nloop=nloop,
+                    group=gid,
+                    nadmm=nadmm,
+                    group_size=gsize,
+                )
+            if cfg.check_results:
+                self.recorder.accuracies(
+                    self.evaluate(), nloop=nloop, group=gid, nadmm=nadmm
+                )
+
+    def run(self) -> MetricsRecorder:
+        """The full experiment (all Nloop outer loops)."""
+        cfg = self.cfg
+        for nloop in range(self._completed_nloops, cfg.nloop):
+            for gid in self.group_order:
+                self.run_round(nloop, gid)
+            self._completed_nloops = nloop + 1
+            if cfg.save_model:
+                self.save(step=self._completed_nloops)
+        if cfg.save_model:
+            self.save(step=cfg.nloop)
+        return self.recorder
+
+    # ----------------------------------------------------------- checkpoint
+
+    def save(self, step: int) -> str:
+        state = {
+            "flat": self.flat,
+            "batch_stats": self.stats,
+            "completed_nloops": np.int64(self._completed_nloops),
+        }
+        return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
+
+    def _restore(self) -> None:
+        state = load_checkpoint(self.cfg.checkpoint_dir)
+        csh = client_sharding(self.mesh)
+        self.flat = jax.device_put(jnp.asarray(state["flat"]), csh)
+        self.stats = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), csh), state["batch_stats"]
+        )
+        self._completed_nloops = int(state["completed_nloops"])
+
+
+def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> MetricsRecorder:
+    """Build a `Trainer` for `cfg`, run it to completion, return metrics."""
+    return Trainer(cfg, verbose=verbose).run()

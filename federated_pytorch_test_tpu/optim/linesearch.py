@@ -32,6 +32,17 @@ Scalar = jnp.ndarray
 PhiFn = Callable[[Scalar], Scalar]  # alpha -> loss(x + alpha * d)
 
 
+def _freeze(pred, new, old):
+    """Keep `old` carry entries where `pred` holds (vmap-safety).
+
+    Under `jax.vmap` a `while_loop` body runs for every batch element while
+    ANY element's condition holds; an element that already terminated must
+    return its carry unchanged. Apply to the whole carry so a future field
+    can't forget its mask.
+    """
+    return jax.tree.map(lambda n, o: jnp.where(pred, o, n), new, old)
+
+
 def backtracking_armijo(
     phi: PhiFn,
     f_old: Scalar,
@@ -62,9 +73,8 @@ def backtracking_armijo(
     def body(carry):
         ci, alpha, f_new = carry
         active = (f_new > f_old + alpha * prod) & (ci < max_iters)
-        alpha_new = jnp.where(active, 0.5 * alpha, alpha)
-        f_next = jnp.where(active, phi(alpha_new), f_new)
-        return ci + active.astype(jnp.int32), alpha_new, f_next
+        alpha_half = 0.5 * alpha
+        return _freeze(~active, (ci + 1, alpha_half, phi(alpha_half)), carry)
 
     f1 = phi(alphabar)
     ci, alpha, _ = lax.while_loop(cond, body, (jnp.int32(0), alphabar, f1))
@@ -161,15 +171,9 @@ def _zoom(
             jnp.where(gphi_j * (bj - aj) >= 0.0, aj, bj),
         )
         aj_new = jnp.where(armijo_fail, aj, alphaj)
-        # (`ci` increments unconditionally, so it is uniform across a vmap
-        # batch and exhaustion ends the batched loop globally — only the
-        # per-element `found` flag needs freezing.)
-        return (
-            ci + 1,
-            jnp.where(found, aj, aj_new),
-            jnp.where(found, bj, bj_new),
-            jnp.where(found, alphak, alphaj),
-            found | found_now,
+        # a frozen element keeps its whole carry, including found=True
+        return _freeze(
+            found, (ci + 1, aj_new, bj_new, alphaj, found | found_now), carry
         )
 
     _, _, _, alphak, _ = lax.while_loop(
@@ -235,15 +239,18 @@ def cubic_linesearch(
         # keep its carry bit-identical — re-running the body with the
         # incremented ci can flip `bracket1`'s `ci > 0` clause and change
         # the exit code (see module docstring on batched while_loops).
-        frozen = code_in != 0  # ci is batch-uniform; only code varies
-        keep = (code == 0) & ~frozen
-        return (
+        # `keep` is algorithmic (an element whose exit code was just set
+        # keeps the alphai it exited with); the _freeze handles elements
+        # that exited on a PREVIOUS iteration.
+        keep = code == 0
+        new = (
             ci + 1,
             jnp.where(keep, alphai_next, alphai),
             jnp.where(keep, alphai1_next, alphai1),
             jnp.where(keep, phi_i, phi_prev),
-            jnp.where(frozen, code_in, code),
+            code,
         )
+        return _freeze(code_in != 0, new, carry)
 
     alpha1 = jnp.asarray(10.0 * lr, dt)
     ci, alphai, alphai1, _, code = lax.while_loop(

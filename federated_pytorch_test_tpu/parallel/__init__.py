@@ -13,6 +13,7 @@ from federated_pytorch_test_tpu.parallel.collectives import (
     client_sum,
     weighted_client_mean,
 )
+from federated_pytorch_test_tpu.parallel.diagnostics import group_distances
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
@@ -32,6 +33,7 @@ __all__ = [
     "client_mesh",
     "client_sharding",
     "client_sum",
+    "group_distances",
     "largest_feasible_mesh",
     "mesh_size",
     "replicate",

@@ -1,0 +1,40 @@
+"""Cross-client diagnostics.
+
+The reference defines (but never calls) `distance_of_layers`, an
+interactive debugging aid computing each layer's distance-from-mean across
+the three clients (reference src/federated_trio.py:170-186; SURVEY.md §4).
+Here it is a first-class jittable diagnostic over the client mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.parallel.collectives import client_mean
+from federated_pytorch_test_tpu.partition import Partition
+
+
+def group_distances(x_local: jnp.ndarray, partition: Partition) -> jnp.ndarray:
+    """Per-group mean distance from the cross-client mean.
+
+    `x_local` is the local client block `[K_loc, N]` of FULL flat params.
+    Returns `[num_groups]` replicated: for each partition group g,
+    `mean_k ‖x_k[g] − mean_j x_j[g]‖` — the reference's per-layer
+    `distance_of_layers` diagnostic (src/federated_trio.py:170-186), with
+    the cross-client mean as the reference point instead of pairwise sums.
+
+    Call inside `shard_map`; one `psum` per call (on the full vector),
+    independent of the number of groups.
+    """
+    center = client_mean(x_local)  # [N] replicated
+    diff = x_local - center  # [K_loc, N]
+    out = []
+    for g in range(partition.num_groups):
+        parts = [
+            jax.lax.slice(diff, (0, s.start), (diff.shape[0], s.start + s.size))
+            for s in partition.groups[g]
+        ]
+        blk = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        out.append(client_mean(jnp.linalg.norm(blk, axis=1)))
+    return jnp.stack(out)

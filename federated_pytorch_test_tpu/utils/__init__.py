@@ -1,0 +1,9 @@
+"""Cross-cutting utilities: metrics, checkpointing, profiling."""
+
+from federated_pytorch_test_tpu.utils.metrics import MetricsRecorder
+from federated_pytorch_test_tpu.utils.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = ["MetricsRecorder", "load_checkpoint", "save_checkpoint"]

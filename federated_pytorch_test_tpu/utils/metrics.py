@@ -1,0 +1,106 @@
+"""Training observability: the reference's five metric series, structured.
+
+The reference's observability is `print()` lines with grep-able formats
+plus documented shell pipelines to extract series from logs (reference
+src/consensus_admm_trio.py:392,517,548-552). The capability contract
+(SURVEY.md §5) is five series: per-client per-batch loss, per-round primal
+and dual residuals, mean rho, and per-client test accuracy. Here every
+observation lands in a structured in-memory store (JSON-serializable) AND
+is printed in a format close to the reference's, so the same shell recipes
+still work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Dict, List
+
+
+@dataclasses.dataclass
+class MetricsRecorder:
+    """Append-only metric series, keyed by name.
+
+    Each record is a dict with a `step` context (nloop/group/nadmm/...)
+    plus the value. `print_fn` mirrors each record to stdout in a
+    reference-style grep-able line.
+    """
+
+    series: Dict[str, List[dict]] = dataclasses.field(default_factory=dict)
+    verbose: bool = True
+    _t0: float = dataclasses.field(default_factory=time.perf_counter)
+
+    def log(self, name: str, value: Any, **context) -> None:
+        rec = {"t": time.perf_counter() - self._t0, "value": value, **context}
+        self.series.setdefault(name, []).append(rec)
+
+    def batch_losses(self, losses, *, nloop, group, nadmm, epoch, minibatch) -> None:
+        """Per-client training losses for one lockstep minibatch.
+
+        Reference line: `layer=%d %d minibatch=%d epoch=%d losses %e,%e,%e`
+        (src/federated_trio.py:352).
+        """
+        vals = [float(v) for v in losses]
+        self.log(
+            "train_loss",
+            vals,
+            nloop=nloop,
+            group=group,
+            nadmm=nadmm,
+            epoch=epoch,
+            minibatch=minibatch,
+        )
+        if self.verbose:
+            print(
+                f"layer={group} {nloop} minibatch={minibatch} epoch={epoch} "
+                "losses " + ",".join(f"{v:e}" for v in vals)
+            )
+
+    def residuals(
+        self, primal, dual, mean_rho, *, nloop, group, nadmm, group_size
+    ) -> None:
+        """Consensus residuals for one averaging/ADMM round.
+
+        Reference line: `layer=%d(%d,%f) ADMM=%d primal=%e dual=%e`
+        (src/consensus_admm_trio.py:517); FedAvg prints only the dual
+        (src/federated_trio.py:359).
+        """
+        ctx = dict(nloop=nloop, group=group, nadmm=nadmm)
+        self.log("dual_residual", float(dual), **ctx)
+        if primal is not None:
+            self.log("primal_residual", float(primal), **ctx)
+        if mean_rho is not None:
+            self.log("mean_rho", float(mean_rho), **ctx)
+        if self.verbose:
+            p = f" primal={float(primal):e}" if primal is not None else ""
+            r = f",{float(mean_rho):f}" if mean_rho is not None else ""
+            print(
+                f"layer={group}({group_size}{r}) ADMM={nadmm}{p} "
+                f"dual={float(dual):e}"
+            )
+
+    def accuracies(self, accs, *, nloop, group, nadmm) -> None:
+        """Per-client top-1 test accuracy (fractions in [0,1]).
+
+        Reference: `verification_error_check` prints per-client percentages
+        (src/federated_trio.py:199-223).
+        """
+        vals = [float(a) for a in accs]
+        self.log("test_accuracy", vals, nloop=nloop, group=group, nadmm=nadmm)
+        if self.verbose:
+            for k, a in enumerate(vals):
+                print(
+                    f"Accuracy of client {k + 1} on the test images: "
+                    f"{100.0 * a:.2f} %"
+                )
+
+    def latest(self, name: str):
+        return self.series[name][-1]["value"] if self.series.get(name) else None
+
+    def to_json(self) -> str:
+        return json.dumps(self.series)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
