@@ -53,21 +53,25 @@ def main() -> None:
     gid = tr.group_order[0]
     epoch_fn, _, init_fn = tr._fns(gid)
     lstate, y, z, rho, extra = init_fn(tr.flat)
+    flat, stats = tr.flat, tr.stats
 
-    def run_epoch(idx):
-        return epoch_fn(
-            tr.flat, lstate, tr.stats, tr.shard_imgs, tr.shard_labels,
+    def run_epoch(flat, lstate, stats, idx):
+        # epoch_fn donates (flat, lstate, stats): thread them through
+        flat, lstate, stats, losses = epoch_fn(
+            flat, lstate, stats, tr.shard_imgs, tr.shard_labels,
             idx, tr.mean, tr.std, y, z, rho,
         )
+        return flat, lstate, stats
 
     idx = tr._epoch_indices(0, gid, 0, 0)[:steps]
-    # warmup / compile
-    out = run_epoch(idx[:2])
-    jax.block_until_ready(out[0])
+    # warmup / compile (same scan length as the timed run — scan length is
+    # static, so a shorter warmup would compile a second program)
+    flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
+    jax.block_until_ready(flat)
 
     t0 = time.perf_counter()
-    out = run_epoch(idx)
-    jax.block_until_ready(out[0])
+    flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
+    jax.block_until_ready(flat)
     dt = time.perf_counter() - t0
 
     n_samples = steps * k * batch

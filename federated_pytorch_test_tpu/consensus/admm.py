@@ -37,6 +37,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from federated_pytorch_test_tpu.consensus.penalties import soft_threshold
 from federated_pytorch_test_tpu.parallel import client_count, client_sum, weighted_client_mean
 
 
@@ -50,6 +51,11 @@ class ADMMConfig:
     bb_alphacorrmin: float = 0.2
     bb_epsilon: float = 1e-3
     bb_rhomax: float = 0.1
+    # elastic-net consensus: soft-threshold znew with this value (> 0
+    # enables). The reference ships this disabled (commented out,
+    # src/consensus_admm_trio_resnet.py:416-419) but keeps the
+    # `sthreshold` helper; here it is a first-class option.
+    z_soft_threshold: float = 0.0
 
 
 class ADMMState(NamedTuple):
@@ -160,6 +166,8 @@ def admm_round(
     # z-update: weighted mean with v = y/rho + x, w = rho so that
     # sum(v*w)/sum(w) == sum(y + rho*x)/sum(rho) (reference :502)
     znew = weighted_client_mean(state.y / rho + x_local, rho)
+    if config.z_soft_threshold > 0.0:
+        znew = soft_threshold(znew, config.z_soft_threshold)
     dual = jnp.linalg.norm(state.z - znew) / n
 
     # y-update (reference :511-513)

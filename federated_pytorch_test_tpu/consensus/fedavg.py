@@ -18,6 +18,7 @@ from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 
+from federated_pytorch_test_tpu.consensus.penalties import soft_threshold
 from federated_pytorch_test_tpu.parallel import client_mean
 
 
@@ -31,14 +32,20 @@ def fedavg_init(n: int, dtype=jnp.float32) -> FedAvgState:
 
 
 def fedavg_round(
-    x_local: jnp.ndarray, state: FedAvgState
+    x_local: jnp.ndarray, state: FedAvgState, z_soft_threshold: float = 0.0
 ) -> Tuple[FedAvgState, dict]:
     """One averaging round over the local client block `[K_loc, N]`.
 
     Returns the new state (z = cross-client mean) and the dual residual
     `‖z − znew‖/N` (reference src/federated_trio.py:357-358).
+
+    `z_soft_threshold > 0` applies the elastic-net proximal soft shrinkage
+    to znew — the reference ships this disabled but keeps the helper
+    (reference src/federated_trio.py:188-196).
     """
     n = x_local.shape[-1]
     znew = client_mean(x_local)
+    if z_soft_threshold > 0.0:
+        znew = soft_threshold(znew, z_soft_threshold)
     dual = jnp.linalg.norm(state.z - znew) / n
     return FedAvgState(z=znew), {"dual_residual": dual}

@@ -68,6 +68,14 @@ class ExperimentConfig:
     bb_epsilon: float = 1e-3
     bb_rhomax: float = 0.1
 
+    # elastic-net consensus: soft-threshold the z-update with this value
+    # (> 0 enables; the reference ships it commented out but keeps the
+    # helper, src/consensus_admm_trio_resnet.py:416-419)
+    z_soft_threshold: float = 0.0
+
+    # write a jax.profiler trace of each epoch here (TPU/host timelines)
+    profile_dir: str | None = None
+
     # flags (reference src/federated_trio.py:28-31)
     init_model: bool = True  # common-seed init across clients
     load_model: bool = False
@@ -102,6 +110,7 @@ class ExperimentConfig:
             bb_alphacorrmin=self.bb_alphacorrmin,
             bb_epsilon=self.bb_epsilon,
             bb_rhomax=self.bb_rhomax,
+            z_soft_threshold=self.z_soft_threshold,
         )
 
     def replace(self, **kw) -> "ExperimentConfig":

@@ -179,7 +179,9 @@ def build_epoch_fn(ctx: GroupContext, mesh):
         out_specs=(c, c, c, P(None, CLIENT_AXIS)),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    # params/opt-state/batch-stats are consumed and re-emitted every epoch:
+    # donate them so XLA updates in place instead of double-buffering
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
 
 def build_round_init_fn(ctx: GroupContext, mesh):
@@ -231,7 +233,9 @@ def build_consensus_fn(ctx: GroupContext, mesh):
 
         def local(flat, y, z, rho, extra, nadmm):
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
-            state, met = fedavg_round(x, FedAvgState(z=z))
+            state, met = fedavg_round(
+                x, FedAvgState(z=z), ctx.admm.z_soft_threshold
+            )
             flat = jax.vmap(
                 lambda f: ctx.partition.insert(f, ctx.gid, state.z)
             )(flat)
@@ -264,6 +268,8 @@ def build_consensus_fn(ctx: GroupContext, mesh):
         out_specs=(c, c, r, c, (c, c), (r, r, r)),
         check_vma=False,
     )
+    # no donation here: the round-init placeholders alias buffers (e.g.
+    # the fedavg extra=(y, y)) and these arrays are one group wide anyway
     return jax.jit(sharded)
 
 

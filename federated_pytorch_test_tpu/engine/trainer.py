@@ -305,7 +305,19 @@ class Trainer:
                 )
 
     def run(self) -> MetricsRecorder:
-        """The full experiment (all Nloop outer loops)."""
+        """The full experiment (all Nloop outer loops).
+
+        With `cfg.profile_dir` set, the whole run is captured as a
+        jax.profiler trace (device + host timelines, viewable in
+        TensorBoard/Perfetto) — the tracing subsystem the reference lacks
+        (SURVEY.md §5: a dead `start_time=time.time()` is all it has).
+        """
+        if self.cfg.profile_dir:
+            with jax.profiler.trace(self.cfg.profile_dir):
+                return self._run_impl()
+        return self._run_impl()
+
+    def _run_impl(self) -> MetricsRecorder:
         cfg = self.cfg
         for nloop in range(self._completed_nloops, cfg.nloop):
             for gid in self.group_order:

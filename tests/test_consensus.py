@@ -235,3 +235,34 @@ def test_elastic_net_and_soft_threshold():
     np.testing.assert_allclose(
         soft_threshold(v, 0.1), np.asarray([-1.9, 0.0, 1.4]), rtol=1e-6, atol=1e-8
     )
+
+
+def test_fedavg_soft_threshold_z(mesh):
+    # elastic-net consensus option: znew is soft-shrunk before broadcast
+    x = jnp.asarray(np.random.RandomState(3).randn(K, N), jnp.float32)
+    state = fedavg_init(N)
+
+    def fn(xl):
+        st, met = fedavg_round(xl, state, z_soft_threshold=0.5)
+        return st.z
+
+    z = np.asarray(_spmd(mesh, fn, x))
+    expected = np.asarray(soft_threshold(jnp.asarray(x.mean(0)), 0.5))
+    np.testing.assert_allclose(z, expected, rtol=1e-6, atol=1e-6)
+    # shrinkage actually fires: small coords are exactly zero
+    assert (np.abs(z) < np.abs(x.mean(0)) + 1e-9).all()
+
+
+def test_admm_soft_threshold_z(mesh):
+    cfg = ADMMConfig(rho0=0.5, z_soft_threshold=0.3)
+    x = jnp.asarray(np.random.RandomState(4).randn(K, N), jnp.float32)
+
+    def fn(xl):
+        st = admm_init(xl, cfg)
+        st2, met = admm_round(xl, st, jnp.int32(0), cfg)
+        return st2.z
+
+    z = np.asarray(_spmd(mesh, fn, x))
+    # y=0, equal rho => znew = soft_threshold(mean(x))
+    expected = np.asarray(soft_threshold(jnp.asarray(x.mean(0)), 0.3))
+    np.testing.assert_allclose(z, expected, rtol=1e-5, atol=1e-7)

@@ -98,3 +98,24 @@ def test_largest_feasible_mesh():
     assert mesh_size(largest_feasible_mesh(8)) == 8
     assert mesh_size(largest_feasible_mesh(12)) == 6  # largest divisor <= 8
     assert mesh_size(largest_feasible_mesh(7)) == 7
+
+
+def test_group_distances_matches_numpy():
+    from federated_pytorch_test_tpu.parallel import group_distances
+    from federated_pytorch_test_tpu.partition import Partition, Segment
+
+    k, n = 4, 10
+    part = Partition(groups=((Segment(0, 6),), (Segment(6, 4),)), total=n)
+    rng = np.random.RandomState(0)
+    x = rng.randn(k, n).astype(np.float32)
+
+    mesh = client_mesh(2)
+    out = _run(mesh, lambda v: group_distances(v, part), jnp.asarray(x))
+
+    center = x.mean(0)
+    expected = [
+        np.mean([np.linalg.norm((x[c] - center)[s.start : s.start + s.size])
+                 for c in range(k)])
+        for s in [part.groups[0][0], part.groups[1][0]]
+    ]
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
