@@ -25,11 +25,9 @@ import time
 def main() -> None:
     bench_device = os.environ.get("BENCH_DEVICE", "")
     if bench_device == "cpu":
-        import jax
-        from jax._src import xla_bridge as xb
+        from federated_pytorch_test_tpu.utils import force_host_cpu
 
-        xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
+        force_host_cpu()
     import jax
     import jax.numpy as jnp
     import numpy as np

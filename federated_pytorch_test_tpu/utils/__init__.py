@@ -5,5 +5,15 @@ from federated_pytorch_test_tpu.utils.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from federated_pytorch_test_tpu.utils.hostcpu import (
+    force_host_cpu,
+    set_host_device_count,
+)
 
-__all__ = ["MetricsRecorder", "load_checkpoint", "save_checkpoint"]
+__all__ = [
+    "MetricsRecorder",
+    "load_checkpoint",
+    "save_checkpoint",
+    "force_host_cpu",
+    "set_host_device_count",
+]

@@ -1,0 +1,61 @@
+"""Force jax onto XLA's host (CPU) platform with a virtual device mesh.
+
+The ambient environment may register a real-TPU PJRT plugin ("axon") at
+interpreter start and pin `JAX_PLATFORMS` to it; initializing that backend
+dials a tunnel and can block indefinitely, and the plugin registration
+overrides a `JAX_PLATFORMS=cpu` environment variable. Tests, benchmarks on
+CPU, and the multi-chip dry run all need the same counter-dance: drop the
+plugin factory, force the platform back to cpu, and (optionally) raise the
+virtual host device count. This module is that dance's single home.
+
+Must run before any jax backend is instantiated (importing jax is fine;
+creating arrays / calling `jax.devices()` is not).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def set_host_device_count(n: int) -> None:
+    """Ensure `XLA_FLAGS` requests at least `n` virtual host devices.
+
+    Replaces an existing smaller `--xla_force_host_platform_device_count`
+    value rather than silently keeping it.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={n}")
+    else:
+        return
+    os.environ["XLA_FLAGS"] = flags
+
+
+def force_host_cpu(min_devices: int | None = None):
+    """Pin jax to the cpu platform; return the jax module.
+
+    With `min_devices`, also guarantees that many virtual host devices (or
+    raises RuntimeError if a backend was already initialized with fewer).
+    """
+    if min_devices is not None:
+        set_host_device_count(min_devices)
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+
+    if min_devices is not None and jax.local_device_count() < min_devices:
+        raise RuntimeError(
+            f"need {min_devices} host devices, have {jax.local_device_count()} "
+            f"on platform {jax.default_backend()!r}; a jax backend was "
+            f"initialized before force_host_cpu could raise the count"
+        )
+    return jax

@@ -13,18 +13,7 @@ the platform is forced back to cpu. This must run before any test module
 imports jax numerics, hence it lives at conftest import time.
 """
 
-import os
+from federated_pytorch_test_tpu.utils import force_host_cpu
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+jax = force_host_cpu(min_devices=8)
 jax.config.update("jax_enable_x64", False)
