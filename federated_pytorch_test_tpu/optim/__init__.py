@@ -11,6 +11,7 @@ optimizer step, including every line-search probe's forward pass, is a
 single fused device computation with no host round-trips.
 """
 
+from federated_pytorch_test_tpu.optim.compact import compact_direction
 from federated_pytorch_test_tpu.optim.lbfgs import (
     LBFGSConfig,
     LBFGSState,
@@ -18,4 +19,10 @@ from federated_pytorch_test_tpu.optim.lbfgs import (
     lbfgs_step,
 )
 
-__all__ = ["LBFGSConfig", "LBFGSState", "lbfgs_init", "lbfgs_step"]
+__all__ = [
+    "LBFGSConfig",
+    "LBFGSState",
+    "compact_direction",
+    "lbfgs_init",
+    "lbfgs_step",
+]

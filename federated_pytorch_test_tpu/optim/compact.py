@@ -1,0 +1,81 @@
+"""Compact-representation L-BFGS direction: the two-loop recursion as matmuls.
+
+The classic two-loop recursion (reference src/lbfgsnew.py:615-637, our
+`lbfgs._two_loop_direction`) is 2m sequentially-dependent BLAS1 passes over
+the [N] parameter vector — each history slot's dot product must finish
+before the next slot can start, so on TPU it runs on the VPU with 2m round
+trips to HBM and the MXU idle.
+
+The Byrd–Nocedal–Schnabel compact representation (SIAM J. Num. An. 1994,
+"Representations of quasi-Newton matrices and their use in limited memory
+methods") writes the SAME inverse-Hessian product in closed form:
+
+    H g = γ g + [S  γY] · [[ R⁻ᵀ(D + γ YᵀY) R⁻¹,  −R⁻ᵀ ],
+                           [ −R⁻¹,                 0    ]] · [Sᵀg; γ Yᵀg]
+
+with S,Y the [m,N] step/grad-difference history, R the upper triangle of
+S Yᵀ (slot-chronological), D its diagonal, and γ the initial Hessian scale
+(`h_diag`). The heavy work becomes four [m,N]-shaped matmuls (Sᵀg, Yᵀg,
+then S·w, Y·u) plus an m×m Gram matrix — all MXU-tileable, one HBM pass
+over the history per phase — and two m×m triangular solves that are
+negligible at m=10. The result is algebraically identical to the two-loop
+recursion's direction (equal up to floating-point roundoff — reduction
+order differs; see tests/test_lbfgs.py equivalence tests).
+
+Invalid history slots (`i >= count`, or degenerate `yᵢ·sᵢ = 0`) are masked
+by zeroing their rows and pinning the corresponding diagonal of R to 1 so
+the triangular solves stay non-singular while the slot's contribution
+vanishes exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+
+def compact_direction(
+    g: jnp.ndarray,
+    s_hist: jnp.ndarray,
+    y_hist: jnp.ndarray,
+    count: jnp.ndarray,
+    h_diag: jnp.ndarray,
+) -> jnp.ndarray:
+    """-H·g via the compact representation over the valid history slots.
+
+    Drop-in replacement for `lbfgs._two_loop_direction` (same signature,
+    same result); `s_hist`/`y_hist` are [m, N] chronological buffers of
+    which the first `count` rows are valid.
+    """
+    m = s_hist.shape[0]
+    dt = g.dtype
+
+    valid = jnp.arange(m) < count
+    s = jnp.where(valid[:, None], s_hist, 0.0)
+    y = jnp.where(valid[:, None], y_hist, 0.0)
+
+    # m x m Gram blocks; one [m,N] @ [N,m] pass each (MXU)
+    sy = s @ y.T  # sy[i, j] = s_i . y_j
+    d_diag = jnp.diagonal(sy)
+    # guard: treat slots with degenerate curvature as invalid too
+    ok = valid & (d_diag != 0.0)
+    s = jnp.where(ok[:, None], s, 0.0)
+    y = jnp.where(ok[:, None], y, 0.0)
+    sy = jnp.where(ok[:, None] & ok[None, :], sy, 0.0)
+    d_diag = jnp.diagonal(sy)
+
+    # R = upper triangle of S Yᵀ, with invalid diagonals pinned to 1 so the
+    # triangular solves are non-singular (their rhs entries are 0 there)
+    r = jnp.triu(sy) + jnp.diag(jnp.where(ok, 0.0, 1.0).astype(dt))
+    yy = y @ y.T
+
+    p = s @ g  # Sᵀg  [m]
+    q = y @ g  # Yᵀg  [m]
+
+    u = solve_triangular(r, p, lower=False)  # R⁻¹ Sᵀg
+    w = solve_triangular(
+        r, d_diag * u + h_diag * (yy @ u) - h_diag * q, lower=False, trans=1
+    )  # R⁻ᵀ((D + γ YᵀY) u − γ Yᵀg)
+
+    hg = h_diag * g + w @ s - h_diag * (u @ y)
+    return -hg

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from federated_pytorch_test_tpu.optim.compact import compact_direction
 from federated_pytorch_test_tpu.optim.linesearch import (
     backtracking_armijo,
     cubic_linesearch,
@@ -67,6 +68,16 @@ class LBFGSConfig:
     # trust-region damping coefficient in batch mode (reference
     # src/lbfgsnew.py:538 `lm0=1e-6`)
     lm0: float = 1e-6
+    # 'compact': Byrd–Nocedal compact representation — the same H·g as the
+    #   two-loop recursion, restructured into MXU-tileable [m,N] matmuls
+    #   (see optim/compact.py). 'two_loop': the masked sequential recursion.
+    direction: str = "compact"
+
+    def __post_init__(self):
+        if self.direction not in ("compact", "two_loop"):
+            raise ValueError(
+                f"direction must be 'compact' or 'two_loop', got {self.direction!r}"
+            )
 
     @property
     def resolved_max_eval(self) -> int:
@@ -300,7 +311,12 @@ def lbfgs_step(
             h_diag = jnp.where(accept, h_new, c.h_diag)
             # NaN H_diag is carried through with only a warning in the
             # reference (src/lbfgsnew.py:610-611); same here implicitly.
-            d = _two_loop_direction(c.g, s_hist, y_hist, hist_count, h_diag)
+            direction_fn = (
+                compact_direction
+                if config.direction == "compact"
+                else _two_loop_direction
+            )
+            d = direction_fn(c.g, s_hist, y_hist, hist_count, h_diag)
             return d, s_hist, y_hist, hist_count, h_diag, alphabar, ravg, ravgsq
 
         (d, s_hist, y_hist, hist_count, h_diag, alphabar, ravg, ravgsq) = lax.cond(
