@@ -59,6 +59,9 @@ class ExperimentConfig:
     lbfgs_history: int = 10
     lbfgs_max_iter: int = 4
     lbfgs_lr: float = 1.0
+    # 'compact' (Byrd–Nocedal, MXU matmuls) or 'two_loop' (sequential
+    # recursion) — the escape hatch if compact misbehaves on some history
+    lbfgs_direction: str = "compact"
 
     # ADMM (reference src/consensus_admm_trio.py:23,37-44)
     admm_rho0: float = 1e-3
@@ -100,6 +103,7 @@ class ExperimentConfig:
             history_size=self.lbfgs_history,
             line_search=True,
             batch_mode=True,
+            direction=self.lbfgs_direction,
         )
 
     def admm_config(self) -> ADMMConfig:
@@ -166,6 +170,34 @@ PRESETS = {
         reg_mode="none",
         bb_update=False,
         shuffle_group_order=True,
+    ),
+    # BASELINE.json config #5 (scale-out, no reference script): K=64
+    # ResNet18 clients on CIFAR100, one client per core on a v4-64 —
+    # the mesh maps clients to devices 1:1 when 64 devices are present,
+    # or folds K into local blocks on smaller meshes (parallel/mesh.py).
+    "fedavg_scale64": ExperimentConfig(
+        name="fedavg_scale64",
+        model="resnet18",
+        dataset="cifar100",
+        n_clients=64,
+        batch=32,
+        strategy="fedavg",
+        reg_mode="none",
+        shuffle_group_order=True,
+        check_results=False,
+    ),
+    "admm_scale64": ExperimentConfig(
+        name="admm_scale64",
+        model="resnet18",
+        dataset="cifar100",
+        n_clients=64,
+        batch=32,
+        strategy="admm",
+        nadmm=3,
+        reg_mode="none",
+        bb_update=False,
+        shuffle_group_order=True,
+        check_results=False,
     ),
 }
 

@@ -50,6 +50,8 @@ class Net(PartitionedModel):
     LINEAR_GROUP_IDS = (2, 3, 4)  # reference src/simple_models.py:29-30
     TRAIN_ORDER = (2, 0, 1, 3, 4)  # reference src/simple_models.py:38-39
 
+    num_classes: int = 10
+
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         x = _maxpool(nn.elu(_conv(6, 5, "VALID", "conv1")(x)))  # 32->28->14
@@ -57,7 +59,7 @@ class Net(PartitionedModel):
         x = x.reshape((x.shape[0], -1))  # 5*5*16 = 400
         x = nn.elu(_dense(120, "fc1")(x))
         x = nn.elu(_dense(84, "fc2")(x))
-        return _dense(10, "fc3")(x)
+        return _dense(self.num_classes, "fc3")(x)
 
 
 class Net1(PartitionedModel):
@@ -70,6 +72,8 @@ class Net1(PartitionedModel):
     LINEAR_GROUP_IDS = (4, 5)  # reference src/simple_models.py:69-70
     TRAIN_ORDER = (2, 5, 1, 3, 0, 4)  # reference src/simple_models.py:78-79
 
+    num_classes: int = 10
+
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         x = nn.elu(_conv(32, 3, "VALID", "conv1")(x))  # 32->30
@@ -80,7 +84,7 @@ class Net1(PartitionedModel):
         x = _maxpool(x)  # 10->5
         x = x.reshape((x.shape[0], -1))  # 5*5*64 = 1600
         x = nn.elu(_dense(512, "fc1")(x))
-        return _dense(10, "fc2")(x)
+        return _dense(self.num_classes, "fc2")(x)
 
 
 class Net2(PartitionedModel):
@@ -103,6 +107,8 @@ class Net2(PartitionedModel):
     LINEAR_GROUP_IDS = (4, 5, 6, 7, 8)  # reference src/simple_models.py:119-120
     TRAIN_ORDER = (7, 2, 1, 4, 8, 6, 3, 0, 5)  # reference src/simple_models.py:130-131
 
+    num_classes: int = 10
+
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         x = _maxpool(nn.elu(_conv(64, 3, "SAME", "conv1")(x)))  # 32->16
@@ -114,4 +120,4 @@ class Net2(PartitionedModel):
         x = nn.elu(_dense(256, "fc2")(x))
         x = nn.elu(_dense(512, "fc3")(x))
         x = nn.elu(_dense(1024, "fc4")(x))
-        return _dense(10, "fc5")(x)
+        return _dense(self.num_classes, "fc5")(x)

@@ -67,15 +67,17 @@ def compact_direction(
     # R = upper triangle of S Yᵀ, with invalid diagonals pinned to 1 so the
     # triangular solves are non-singular (their rhs entries are 0 there)
     r = jnp.triu(sy) + jnp.diag(jnp.where(ok, 0.0, 1.0).astype(dt))
-    yy = y @ y.T
 
     p = s @ g  # Sᵀg  [m]
     q = y @ g  # Yᵀg  [m]
 
     u = solve_triangular(r, p, lower=False)  # R⁻¹ Sᵀg
+    # (YᵀY)u contracted as Y(uᵀY): reuses uy and avoids the [m,N]@[N,m]
+    # Gram pass — (yy @ u)[i] = y_i · Σ_j u_j y_j = (y @ uy)[i]
+    uy = u @ y  # [N]
     w = solve_triangular(
-        r, d_diag * u + h_diag * (yy @ u) - h_diag * q, lower=False, trans=1
+        r, d_diag * u + h_diag * (y @ uy) - h_diag * q, lower=False, trans=1
     )  # R⁻ᵀ((D + γ YᵀY) u − γ Yᵀg)
 
-    hg = h_diag * g + w @ s - h_diag * (u @ y)
+    hg = h_diag * g + w @ s - h_diag * uy
     return -hg

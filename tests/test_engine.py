@@ -26,18 +26,25 @@ def tiny(preset: str, **over) -> ExperimentConfig:
 
 
 def test_presets_cover_reference_drivers():
-    # the five reference driver scripts -> five presets (SURVEY.md §2 C12)
+    # the five reference driver scripts -> five presets (SURVEY.md §2 C12),
+    # plus the two BASELINE.json config-#5 scale-out presets
     assert set(PRESETS) == {
         "no_consensus",
         "fedavg",
         "fedavg_resnet",
         "admm",
         "admm_resnet",
+        "fedavg_scale64",
+        "admm_scale64",
     }
     assert PRESETS["admm"].nadmm == 5 and PRESETS["admm"].bb_update
     assert PRESETS["fedavg"].batch == 512
     assert PRESETS["admm_resnet"].bb_update is False
     assert PRESETS["no_consensus"].strategy == "none"
+    for name in ("fedavg_scale64", "admm_scale64"):
+        assert PRESETS[name].n_clients == 64
+        assert PRESETS[name].dataset == "cifar100"
+        assert PRESETS[name].model == "resnet18"
 
 
 def test_fedavg_round_trains_and_syncs():
@@ -138,6 +145,29 @@ def test_resnet_smoke_with_batch_stats():
         [np.ravel(x) for x in __import__("jax").tree.leaves(tr.stats)]
     )
     assert np.isfinite(stats).all()
+
+
+def test_scale64_preset_runs_on_8_devices():
+    # BASELINE.json config #5: K=64 clients, CIFAR100, one client per core
+    # on a v4-64. On the 8-device CPU mesh the 64 clients fold into local
+    # blocks of 8; the model is downsized for CPU CI but keeps the
+    # 100-class head the preset specifies.
+    src = synthetic_cifar(n_train=64 * 10, n_test=128, num_classes=100)
+    cfg = get_preset(
+        "fedavg_scale64", model="net", batch=5, nloop=1, nadmm=1,
+        shuffle_group_order=False,
+    )
+    tr = Trainer(cfg, verbose=False, source=src)
+    assert tr.cfg.n_clients == 64 and tr.fed.num_classes == 100
+    tr.group_order = tr.group_order[:1]
+    rec = tr.run()
+    flat = np.asarray(tr.flat)
+    assert flat.shape[0] == 64
+    gid = tr.group_order[0]
+    for seg in tr.partition.groups[gid]:
+        blk = flat[:, seg.start : seg.start + seg.size]
+        assert np.abs(blk - blk[:1]).max() == 0.0  # all 64 synced
+    assert np.isfinite(np.mean(rec.series["train_loss"][-1]["value"]))
 
 
 def test_k6_clients_on_3_devices_local_blocks():
