@@ -59,8 +59,9 @@ class ExperimentConfig:
     lbfgs_history: int = 10
     lbfgs_max_iter: int = 4
     lbfgs_lr: float = 1.0
-    # 'compact' (Byrd–Nocedal, MXU matmuls) or 'two_loop' (sequential
-    # recursion) — the escape hatch if compact misbehaves on some history
+    # 'compact' (Byrd–Nocedal, MXU matmuls), 'pallas' (compact with the
+    # history traffic fused into two Pallas kernels, ops/compact_pallas.py)
+    # or 'two_loop' (sequential recursion — the escape hatch)
     lbfgs_direction: str = "compact"
 
     # ADMM (reference src/consensus_admm_trio.py:23,37-44)

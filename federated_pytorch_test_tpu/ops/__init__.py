@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The compute path is JAX/XLA; these kernels exist where fusion beyond what
+XLA does automatically pays off on TPU — primarily the L-BFGS compact
+direction, whose history-sized matmul chain XLA schedules as ~5 HBM passes
+over the `[m, N]` buffers but a fused pair of kernels does in 2
+(see `ops/compact_pallas.py`).
+
+All kernels run in interpret mode off-TPU (CPU tests / the virtual
+8-device mesh) and compiled on real TPU chips.
+"""
+
+from federated_pytorch_test_tpu.ops.compact_pallas import (
+    compact_direction_pallas,
+    fused_gram_projections,
+)
+
+__all__ = [
+    "compact_direction_pallas",
+    "fused_gram_projections",
+]

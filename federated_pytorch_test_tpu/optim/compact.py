@@ -15,23 +15,72 @@ methods") writes the SAME inverse-Hessian product in closed form:
 
 with S,Y the [m,N] step/grad-difference history, R the upper triangle of
 S Yᵀ (slot-chronological), D its diagonal, and γ the initial Hessian scale
-(`h_diag`). The heavy work becomes four [m,N]-shaped matmuls (Sᵀg, Yᵀg,
-then S·w, Y·u) plus an m×m Gram matrix — all MXU-tileable, one HBM pass
-over the history per phase — and two m×m triangular solves that are
-negligible at m=10. The result is algebraically identical to the two-loop
-recursion's direction (equal up to floating-point roundoff — reduction
-order differs; see tests/test_lbfgs.py equivalence tests).
+(`h_diag`). The heavy work becomes a handful of [m,N]-shaped matmuls — all
+MXU-tileable — and two m×m triangular solves that are negligible at m=10.
+The result is algebraically identical to the two-loop recursion's
+direction (equal up to floating-point roundoff — reduction order differs;
+see tests/test_lbfgs.py equivalence tests).
 
 Invalid history slots (`i >= count`, or degenerate `yᵢ·sᵢ = 0`) are masked
 by zeroing their rows and pinning the corresponding diagonal of R to 1 so
 the triangular solves stay non-singular while the slot's contribution
-vanishes exactly.
+vanishes exactly. That masking + solve sequence lives in `compact_solves`,
+shared with the fused Pallas backend (ops/compact_pallas.py) so the two
+backends cannot drift.
 """
 
 from __future__ import annotations
 
+from typing import Callable, Tuple
+
 import jax.numpy as jnp
 from jax.scipy.linalg import solve_triangular
+
+
+def compact_solves(
+    sy: jnp.ndarray,
+    p: jnp.ndarray,
+    q: jnp.ndarray,
+    valid: jnp.ndarray,
+    h_diag: jnp.ndarray,
+    yyu: Callable[[jnp.ndarray], Tuple[jnp.ndarray, object]],
+):
+    """The middle section shared by both compact backends.
+
+    Given the Gram/projection contractions `sy = S Yᵀ` [m,m], `p = Sᵀg`,
+    `q = Yᵀg` [m] (computed over `valid`-masked rows), masks
+    degenerate-curvature slots, builds R, and runs the two triangular
+    solves. `yyu(u)` must return `((YᵀY) u, aux)` — the pure-JAX backend
+    contracts it as `Y (u @ Y)` reusing `uy` as aux; the Pallas backend
+    has the m×m `Y Yᵀ` from its fused pass and uses `yy @ u`.
+
+    Returns `(u, w, ok, aux)` with `u = R⁻¹Sᵀg`,
+    `w = R⁻ᵀ((D + γ YᵀY)u − γ Yᵀg)`, both exactly zero at non-`ok` slots.
+    """
+    dt = sy.dtype
+    d_diag = jnp.diagonal(sy)
+    # guard: treat slots with degenerate curvature as invalid too
+    ok = valid & (d_diag != 0.0)
+    pair = ok[:, None] & ok[None, :]
+    sy = jnp.where(pair, sy, 0.0)
+    p = jnp.where(ok, p, 0.0)
+    q = jnp.where(ok, q, 0.0)
+    d_diag = jnp.diagonal(sy)
+
+    # R = upper triangle of S Yᵀ, with invalid diagonals pinned to 1 so the
+    # triangular solves are non-singular (their rhs entries are 0 there —
+    # hence u, w are exactly 0 at those slots and the explicit re-masking
+    # below is belt-and-braces for NaN-contaminated invalid slots)
+    r = jnp.triu(sy) + jnp.diag(jnp.where(ok, 0.0, 1.0).astype(dt))
+
+    u = solve_triangular(r, p, lower=False)  # R⁻¹ Sᵀg
+    u = jnp.where(ok, u, 0.0)
+    yyu_vec, aux = yyu(u)
+    w = solve_triangular(
+        r, d_diag * u + h_diag * yyu_vec - h_diag * q, lower=False, trans=1
+    )  # R⁻ᵀ((D + γ YᵀY) u − γ Yᵀg)
+    w = jnp.where(ok, w, 0.0)
+    return u, w, ok, aux
 
 
 def compact_direction(
@@ -48,36 +97,24 @@ def compact_direction(
     which the first `count` rows are valid.
     """
     m = s_hist.shape[0]
-    dt = g.dtype
 
     valid = jnp.arange(m) < count
     s = jnp.where(valid[:, None], s_hist, 0.0)
     y = jnp.where(valid[:, None], y_hist, 0.0)
 
-    # m x m Gram blocks; one [m,N] @ [N,m] pass each (MXU)
+    # the heavy contractions: [m,N] @ [N,m] / [m,N] @ [N] passes (MXU)
     sy = s @ y.T  # sy[i, j] = s_i . y_j
-    d_diag = jnp.diagonal(sy)
-    # guard: treat slots with degenerate curvature as invalid too
-    ok = valid & (d_diag != 0.0)
-    s = jnp.where(ok[:, None], s, 0.0)
-    y = jnp.where(ok[:, None], y, 0.0)
-    sy = jnp.where(ok[:, None] & ok[None, :], sy, 0.0)
-    d_diag = jnp.diagonal(sy)
-
-    # R = upper triangle of S Yᵀ, with invalid diagonals pinned to 1 so the
-    # triangular solves are non-singular (their rhs entries are 0 there)
-    r = jnp.triu(sy) + jnp.diag(jnp.where(ok, 0.0, 1.0).astype(dt))
-
     p = s @ g  # Sᵀg  [m]
     q = y @ g  # Yᵀg  [m]
 
-    u = solve_triangular(r, p, lower=False)  # R⁻¹ Sᵀg
-    # (YᵀY)u contracted as Y(uᵀY): reuses uy and avoids the [m,N]@[N,m]
-    # Gram pass — (yy @ u)[i] = y_i · Σ_j u_j y_j = (y @ uy)[i]
-    uy = u @ y  # [N]
-    w = solve_triangular(
-        r, d_diag * u + h_diag * (y @ uy) - h_diag * q, lower=False, trans=1
-    )  # R⁻ᵀ((D + γ YᵀY) u − γ Yᵀg)
+    def yyu(u):
+        # (YᵀY)u contracted as Y(uᵀY): (yy @ u)[i] = y_i · Σ_j u_j y_j =
+        # (y @ uy)[i]; avoids an [m,N]@[N,m] Gram pass and `uy` is reused
+        # in the final assembly
+        uy = u @ y  # [N]
+        return y @ uy, uy
+
+    u, w, _, uy = compact_solves(sy, p, q, valid, h_diag, yyu)
 
     hg = h_diag * g + w @ s - h_diag * uy
     return -hg

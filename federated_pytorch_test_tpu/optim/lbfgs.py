@@ -49,6 +49,14 @@ from federated_pytorch_test_tpu.optim.linesearch import (
     cubic_linesearch,
 )
 
+
+def _pallas_direction(g, s_hist, y_hist, count, h_diag):
+    # lazy import: pay the jax.experimental.pallas import cost only when
+    # the 'pallas' backend is actually selected
+    from federated_pytorch_test_tpu.ops import compact_direction_pallas
+
+    return compact_direction_pallas(g, s_hist, y_hist, count, h_diag)
+
 LossFn = Callable[[jnp.ndarray], jnp.ndarray]  # flat params -> scalar loss
 
 
@@ -71,12 +79,17 @@ class LBFGSConfig:
     # 'compact': Byrd–Nocedal compact representation — the same H·g as the
     #   two-loop recursion, restructured into MXU-tileable [m,N] matmuls
     #   (see optim/compact.py). 'two_loop': the masked sequential recursion.
+    # 'pallas': the compact form with its history traffic fused into two
+    #   Pallas kernels — one HBM pass for all four Gram/projection
+    #   contractions, one for the direction assembly (see
+    #   ops/compact_pallas.py; interpret mode off-TPU).
     direction: str = "compact"
 
     def __post_init__(self):
-        if self.direction not in ("compact", "two_loop"):
+        if self.direction not in ("compact", "two_loop", "pallas"):
             raise ValueError(
-                f"direction must be 'compact' or 'two_loop', got {self.direction!r}"
+                "direction must be 'compact', 'two_loop' or 'pallas', "
+                f"got {self.direction!r}"
             )
 
     @property
@@ -311,11 +324,11 @@ def lbfgs_step(
             h_diag = jnp.where(accept, h_new, c.h_diag)
             # NaN H_diag is carried through with only a warning in the
             # reference (src/lbfgsnew.py:610-611); same here implicitly.
-            direction_fn = (
-                compact_direction
-                if config.direction == "compact"
-                else _two_loop_direction
-            )
+            direction_fn = {
+                "compact": compact_direction,
+                "two_loop": _two_loop_direction,
+                "pallas": _pallas_direction,
+            }[config.direction]
             d = direction_fn(c.g, s_hist, y_hist, hist_count, h_diag)
             return d, s_hist, y_hist, hist_count, h_diag, alphabar, ravg, ravgsq
 
