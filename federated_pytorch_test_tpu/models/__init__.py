@@ -14,12 +14,14 @@ order — replacing the reference's `linear_layer_ids` /
 from federated_pytorch_test_tpu.models.base import PartitionedModel, init_client_params
 from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
 from federated_pytorch_test_tpu.models.resnet import ResNet18
+from federated_pytorch_test_tpu.models.transformer import ViT
 
 MODELS = {
     "net": Net,
     "net1": Net1,
     "net2": Net2,
     "resnet18": ResNet18,
+    "vit": ViT,
 }
 
 __all__ = [
@@ -27,6 +29,7 @@ __all__ = [
     "Net1",
     "Net2",
     "ResNet18",
+    "ViT",
     "PartitionedModel",
     "init_client_params",
     "MODELS",

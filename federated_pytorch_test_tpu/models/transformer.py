@@ -1,0 +1,152 @@
+"""Transformer model family with partition metadata.
+
+The reference has no attention models (SURVEY.md §2.3: no sequence axis
+anywhere), but this framework treats transformers and long-context as
+first-class: `ViT` is a vision transformer over CIFAR 4x4 patches that
+plugs into the same partial-parameter federated/ADMM engine as the CNNs —
+its partition groups are (embedding+positions), each encoder block, and
+the head, mirroring how the reference groups ResNet18's 62 tensors into 10
+blocks (reference src/federated_trio_resnet.py:174-178).
+
+Attention is pluggable: `attn_impl='dense'` runs the single-device
+reference path; `attn_impl='ring'` runs ring attention over the `seq` mesh
+axis (parallel/ring.py) for sequences sharded across devices — the model
+code is identical either way, which is the point: sequence parallelism is
+a property of the call context (mesh + shard_map), not of the model.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import (
+    PartitionedModel,
+    bias_init,
+    kernel_init,
+)
+from federated_pytorch_test_tpu.parallel.ring import (
+    SEQ_AXIS,
+    dense_attention,
+    ring_attention,
+)
+
+
+class MultiHeadAttention(nn.Module):
+    """QKV projection + pluggable attention core + output projection."""
+
+    dim: int
+    num_heads: int
+    attn_impl: str = "dense"  # 'dense' | 'ring'
+    causal: bool = False
+    seq_axis: str = SEQ_AXIS
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, s, _ = x.shape
+        h, hd = self.num_heads, self.dim // self.num_heads
+        qkv = nn.Dense(
+            3 * self.dim, name="qkv", kernel_init=kernel_init, bias_init=bias_init
+        )(x)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, hd), 3, axis=2)
+        if self.attn_impl == "ring":
+            out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        else:
+            out = dense_attention(q, k, v, causal=self.causal)
+        out = out.reshape(b, s, self.dim)
+        return nn.Dense(
+            self.dim, name="proj", kernel_init=kernel_init, bias_init=bias_init
+        )(out)
+
+
+class Block(nn.Module):
+    """Pre-norm encoder block: LN -> MHA -> +res; LN -> MLP -> +res."""
+
+    dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    attn_impl: str = "dense"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.LayerNorm(name="ln1")(x)
+        x = x + MultiHeadAttention(
+            self.dim,
+            self.num_heads,
+            attn_impl=self.attn_impl,
+            causal=self.causal,
+            name="attn",
+        )(y)
+        y = nn.LayerNorm(name="ln2")(x)
+        y = nn.Dense(
+            self.mlp_ratio * self.dim,
+            name="fc1",
+            kernel_init=kernel_init,
+            bias_init=bias_init,
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(
+            self.dim, name="fc2", kernel_init=kernel_init, bias_init=bias_init
+        )(y)
+        return x + y
+
+
+class ViT(PartitionedModel):
+    """Tiny vision transformer for 32x32 inputs (4x4 patches, 64 tokens).
+
+    Partition groups: 0 = patch embedding + positions, 1..4 = encoder
+    blocks (the last one also carries the pre-head LayerNorm — feature
+    extraction ends there), 5 = the classifier head ALONE, so elastic-net
+    regularization touches only true linear weights, matching how the
+    CNN/ResNet groups expose fc layers (reference src/simple_models.py:29-30)
+    and never normalization parameters.
+    """
+
+    GROUP_PATHS = (
+        (("embed",), ("pos_embed",)),
+        (("block0",),),
+        (("block1",),),
+        (("block2",),),
+        (("block3",), ("ln_out",)),
+        (("head",),),
+    )
+    LINEAR_GROUP_IDS = (5,)
+    TRAIN_ORDER = (0, 1, 2, 3, 4, 5)
+
+    num_classes: int = 10
+    dim: int = 64
+    depth: int = 4  # must match the 4 block groups above
+    num_heads: int = 4
+    patch: int = 4
+    attn_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        assert self.depth == 4, "GROUP_PATHS pins depth=4; add groups to change"
+        b = x.shape[0]
+        x = nn.Conv(
+            self.dim,
+            (self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            name="embed",
+            kernel_init=kernel_init,
+            bias_init=bias_init,
+        )(x)  # [B, 8, 8, dim]
+        x = x.reshape(b, -1, self.dim)  # [B, 64, dim]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.dim)
+        )
+        x = x + pos
+        for i in range(self.depth):
+            x = Block(
+                self.dim,
+                self.num_heads,
+                attn_impl=self.attn_impl,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(name="ln_out")(x)
+        x = jnp.mean(x, axis=1)  # mean-pool tokens
+        return nn.Dense(
+            self.num_classes, name="head", kernel_init=kernel_init, bias_init=bias_init
+        )(x)

@@ -14,6 +14,13 @@ from federated_pytorch_test_tpu.parallel.collectives import (
     weighted_client_mean,
 )
 from federated_pytorch_test_tpu.parallel.diagnostics import group_distances
+from federated_pytorch_test_tpu.parallel.ring import (
+    SEQ_AXIS,
+    dense_attention,
+    ring_attention,
+    seq_shard,
+    seq_unshard,
+)
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
@@ -27,7 +34,12 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 
 __all__ = [
     "CLIENT_AXIS",
+    "SEQ_AXIS",
     "all_clients",
+    "dense_attention",
+    "ring_attention",
+    "seq_shard",
+    "seq_unshard",
     "client_count",
     "client_mean",
     "client_mesh",
