@@ -21,6 +21,11 @@ from federated_pytorch_test_tpu.data.cifar import (
     load_cifar100,
     synthetic_cifar,
 )
+from federated_pytorch_test_tpu.data.native import (
+    PrefetchBatcher,
+    chw_to_hwc,
+    decode_records,
+)
 from federated_pytorch_test_tpu.data.pipeline import (
     BIASED_STATS,
     FederatedDataset,
@@ -34,8 +39,11 @@ __all__ = [
     "BIASED_STATS",
     "DataSource",
     "FederatedDataset",
+    "PrefetchBatcher",
+    "chw_to_hwc",
     "client_splits",
     "client_stats",
+    "decode_records",
     "load_cifar",
     "load_cifar10",
     "load_cifar100",

@@ -47,8 +47,14 @@ class DataSource:
 
 
 def _planes_to_hwc(flat: np.ndarray) -> np.ndarray:
-    """CIFAR stores 3072 bytes as R/G/B planes; convert to HWC uint8."""
-    return flat.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+    """CIFAR stores 3072 bytes as R/G/B planes; convert to HWC uint8.
+
+    Routed through the native multithreaded transpose
+    (native/cifar_loader.cpp) when available; numpy otherwise — identical
+    bytes either way."""
+    from federated_pytorch_test_tpu.data.native import chw_to_hwc
+
+    return chw_to_hwc(np.asarray(flat, np.uint8))
 
 
 def _load_pickle_batches(root: str, files, label_key: bytes):
@@ -63,13 +69,17 @@ def _load_pickle_batches(root: str, files, label_key: bytes):
 
 def _load_bin_records(root: str, files, label_bytes: int):
     """The binary archive layout: each record is `label_bytes` label bytes
-    followed by 3072 image bytes (fine label is the last label byte)."""
+    followed by 3072 image bytes (fine label is the last label byte).
+    Decoded by the native loader (native/cifar_loader.cpp) when available."""
+    from federated_pytorch_test_tpu.data.native import decode_records
+
     images, labels = [], []
     rec = label_bytes + 3072
     for fn in files:
         raw = np.fromfile(os.path.join(root, fn), np.uint8).reshape(-1, rec)
-        labels.append(raw[:, label_bytes - 1].astype(np.int32))
-        images.append(_planes_to_hwc(raw[:, label_bytes:]))
+        img, lbl = decode_records(raw, label_bytes)
+        images.append(img)
+        labels.append(lbl)
     return np.concatenate(images), np.concatenate(labels)
 
 

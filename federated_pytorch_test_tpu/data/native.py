@@ -1,0 +1,241 @@
+"""ctypes bindings for the native data-loader runtime (native/cifar_loader.cpp).
+
+The shared library is compiled on demand with g++ into
+``native/build/libcifar_loader.so`` (no pybind11 in this environment; the
+C ABI + ctypes keeps the binding dependency-free). Every entry point has a
+numpy fallback, selected automatically when the toolchain or library is
+unavailable or ``FEDTPU_NO_NATIVE=1`` is set — behavior is bit-identical
+either way (tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "cifar_loader.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libcifar_loader.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # compile to a process-unique temp path, then rename: os.rename is
+    # atomic, so concurrent first-use builds from several processes can
+    # never dlopen a partially written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        warnings.warn(f"native loader build failed ({e}); using numpy fallback")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if
+    unavailable (fallbacks engage)."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("FEDTPU_NO_NATIVE") == "1":
+            _lib_failed = True
+            return None
+        # a prebuilt .so without the source alongside (stripped install) is
+        # used as-is; rebuild only when the source is present and newer
+        have_so = os.path.exists(_SO)
+        have_src = os.path.exists(_SRC)
+        stale = (
+            have_so and have_src and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if not have_so or stale:
+            if not have_src or not _build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            warnings.warn(f"native loader dlopen failed ({e}); using numpy fallback")
+            _lib_failed = True
+            return None
+        lib.cifar_chw_to_hwc.argtypes = [_u8p, ctypes.c_int64, _u8p, ctypes.c_int]
+        lib.cifar_chw_to_hwc.restype = None
+        lib.cifar_decode_records.argtypes = [
+            _u8p, ctypes.c_int64, ctypes.c_int, _u8p, _i32p, ctypes.c_int,
+        ]
+        lib.cifar_decode_records.restype = None
+        lib.batcher_create.argtypes = [
+            _u8p, _i32p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+        ]
+        lib.batcher_create.restype = ctypes.c_void_p
+        lib.batcher_next.argtypes = [ctypes.c_void_p, _u8p, _i32p]
+        lib.batcher_next.restype = ctypes.c_int64
+        lib.batcher_destroy.argtypes = [ctypes.c_void_p]
+        lib.batcher_destroy.restype = None
+        _lib = lib
+        return _lib
+
+
+def _threads() -> int:
+    return max(1, os.cpu_count() or 1)
+
+
+def chw_to_hwc(flat: np.ndarray) -> np.ndarray:
+    """[n, 3072] CHW-plane uint8 -> [n, 32, 32, 3] HWC uint8."""
+    flat = np.ascontiguousarray(flat, np.uint8)
+    n = flat.shape[0]
+    lib = get_lib()
+    if lib is None:
+        return flat.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+    out = np.empty((n, 32, 32, 3), np.uint8)
+    lib.cifar_chw_to_hwc(
+        flat.ctypes.data_as(_u8p), n, out.ctypes.data_as(_u8p), _threads()
+    )
+    return out
+
+
+def decode_records(raw: np.ndarray, label_bytes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """[n, label_bytes + 3072] raw .bin records -> (HWC images, int32 fine
+    labels). Fine label = last label byte (cifar-100 records are
+    [coarse, fine])."""
+    raw = np.ascontiguousarray(raw, np.uint8)
+    n = raw.shape[0]
+    lib = get_lib()
+    if lib is None:
+        labels = raw[:, label_bytes - 1].astype(np.int32)
+        images = chw_to_hwc(raw[:, label_bytes:])
+        return images, labels
+    images = np.empty((n, 32, 32, 3), np.uint8)
+    labels = np.empty((n,), np.int32)
+    lib.cifar_decode_records(
+        raw.ctypes.data_as(_u8p), n, label_bytes,
+        images.ctypes.data_as(_u8p), labels.ctypes.data_as(_i32p), _threads(),
+    )
+    return images, labels
+
+
+class PrefetchBatcher:
+    """Background-thread minibatch prefetcher over a host dataset.
+
+    Reshuffles every epoch (deterministic in `seed`) and stages up to
+    `prefetch_depth` batches ahead in native buffers — the host-streaming
+    companion to the on-device index-gather pipeline (data/pipeline.py),
+    for datasets that do not fit on device. Iterating yields
+    `(images [b,32,32,3] uint8, labels [b] int32)` forever; call `close()`
+    (or use as a context manager) to stop the producer thread.
+
+    Falls back to a numpy implementation with the same epoch semantics
+    (different permutation stream) when the native library is unavailable.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch: int,
+        seed: int = 0,
+        drop_last: bool = True,
+        prefetch_depth: int = 4,
+    ):
+        assert images.ndim == 4 and images.dtype == np.uint8
+        assert len(images) == len(labels)
+        if not 0 < batch <= len(images):
+            raise ValueError(
+                f"batch {batch} must be in (0, {len(images)}] — a batch "
+                "larger than the dataset can never be filled"
+            )
+        # keep references so the native side's borrowed pointers stay alive
+        self._images = np.ascontiguousarray(images)
+        self._labels = np.ascontiguousarray(labels, np.int32)
+        self.batch = int(batch)
+        self.drop_last = drop_last
+        self._seed = seed
+        self._lib = get_lib()
+        self._handle = None
+        self._closed = False
+        if self._lib is not None:
+            self._handle = self._lib.batcher_create(
+                self._images.ctypes.data_as(_u8p),
+                self._labels.ctypes.data_as(_i32p),
+                len(self._images), self.batch, seed, int(drop_last),
+                prefetch_depth,
+            )
+        if self._handle is None:
+            self._rng = np.random.default_rng(seed)
+            self._order: list[int] = []
+            self._off = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._closed:
+            raise StopIteration
+        if self._handle is not None:
+            img = np.empty((self.batch, 32, 32, 3), np.uint8)
+            lbl = np.empty((self.batch,), np.int32)
+            n = self._lib.batcher_next(
+                self._handle, img.ctypes.data_as(_u8p), lbl.ctypes.data_as(_i32p)
+            )
+            if n < 0:
+                raise StopIteration
+            return img[:n], lbl[:n]
+        # numpy fallback
+        n_total = len(self._images)
+        if self._off + self.batch > n_total and (
+            self.drop_last or self._off >= n_total
+        ):
+            self._order = []
+        if not self._order:
+            self._order = list(self._rng.permutation(n_total))
+            self._off = 0
+        idx = self._order[self._off : self._off + self.batch]
+        self._off += self.batch
+        return self._images[idx], self._labels[idx]
+
+    def close(self):
+        self._closed = True
+        if self._handle is not None:
+            self._lib.batcher_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,218 @@
+// Native data-loader runtime: CIFAR record decode + threaded batch prefetch.
+//
+// The reference delegates data loading to torchvision's DataLoader with one
+// worker thread (reference src/federated_trio.py:68-70); its own code has no
+// native components at all (SURVEY.md §2.1). This framework's host-side IO
+// runtime is native where it counts:
+//
+//  * cifar_chw_to_hwc / cifar_decode_records: the plane->interleaved
+//    transpose of every image (the one real CPU pass over the whole
+//    dataset at startup), multithreaded across record ranges.
+//  * batcher_*: a background-thread minibatch prefetcher over a bounded
+//    ring of staging buffers (Fisher-Yates reshuffle per epoch), for
+//    host-streaming pipelines whose dataset does not fit on device.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this environment);
+// the Python side (data/native.py) compiles this file on demand with g++
+// and falls back to numpy transparently when unavailable.
+//
+// Thread-safety contract: a batcher handle may be consumed from one Python
+// thread while its producer thread fills buffers; decode entry points are
+// stateless and reentrant.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kImgBytes = 3072;  // 3 x 32 x 32
+constexpr int64_t kHW = 1024;        // 32 x 32
+
+// One image: CHW planes (R[1024] G[1024] B[1024]) -> HWC interleaved.
+inline void transpose_one(const uint8_t* src, uint8_t* dst) {
+  const uint8_t* r = src;
+  const uint8_t* g = src + kHW;
+  const uint8_t* b = src + 2 * kHW;
+  for (int64_t p = 0; p < kHW; ++p) {
+    dst[3 * p + 0] = r[p];
+    dst[3 * p + 1] = g[p];
+    dst[3 * p + 2] = b[p];
+  }
+}
+
+void parallel_for(int64_t n, int n_threads, void (*fn)(int64_t, int64_t, void*),
+                  void* ctx) {
+  if (n_threads <= 1 || n < 2 * n_threads) {
+    fn(0, n, ctx);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back([=] { fn(lo, hi, ctx); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// [n, 3072] CHW-plane images -> [n, 32, 32, 3] HWC. Reentrant.
+void cifar_chw_to_hwc(const uint8_t* src, int64_t n, uint8_t* dst,
+                      int n_threads) {
+  struct Ctx {
+    const uint8_t* src;
+    uint8_t* dst;
+  } ctx{src, dst};
+  parallel_for(
+      n, n_threads,
+      [](int64_t lo, int64_t hi, void* c) {
+        auto* x = static_cast<Ctx*>(c);
+        for (int64_t i = lo; i < hi; ++i)
+          transpose_one(x->src + i * kImgBytes, x->dst + i * kImgBytes);
+      },
+      &ctx);
+}
+
+// Raw .bin records ([label_bytes | 3072 image bytes] x n) -> HWC images +
+// int32 fine labels (the LAST label byte, matching the published layout
+// where cifar-100 records carry [coarse, fine]). Reentrant.
+void cifar_decode_records(const uint8_t* raw, int64_t n, int label_bytes,
+                          uint8_t* images, int32_t* labels, int n_threads) {
+  struct Ctx {
+    const uint8_t* raw;
+    uint8_t* images;
+    int32_t* labels;
+    int64_t rec;
+    int lb;
+  } ctx{raw, images, labels, label_bytes + kImgBytes, label_bytes};
+  parallel_for(
+      n, n_threads,
+      [](int64_t lo, int64_t hi, void* c) {
+        auto* x = static_cast<Ctx*>(c);
+        for (int64_t i = lo; i < hi; ++i) {
+          const uint8_t* r = x->raw + i * x->rec;
+          x->labels[i] = static_cast<int32_t>(r[x->lb - 1]);
+          transpose_one(r + x->lb, x->images + i * kImgBytes);
+        }
+      },
+      &ctx);
+}
+
+// ---------------------------------------------------------------------------
+// Prefetching batcher: producer thread, bounded ring of staging buffers.
+
+struct Batcher {
+  const uint8_t* images;  // [n, 3072] HWC bytes (not owned)
+  const int32_t* labels;  // [n] (not owned)
+  int64_t n;
+  int64_t batch;
+  bool drop_last;
+  uint64_t seed;
+  int64_t epoch;
+
+  struct Slot {
+    std::vector<uint8_t> img;
+    std::vector<int32_t> lbl;
+    int64_t count;
+  };
+  std::queue<Slot> ready;
+  size_t capacity;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::atomic<bool> stop{false};
+  std::thread producer;
+
+  void run() {
+    std::vector<int64_t> perm(n);
+    for (int64_t i = 0; i < n; ++i) perm[i] = i;
+    while (!stop.load()) {
+      // fresh shuffle each epoch, deterministic in (seed, epoch)
+      std::mt19937_64 rng(seed + static_cast<uint64_t>(epoch) * 0x9e3779b97f4a7c15ULL);
+      for (int64_t i = n - 1; i > 0; --i) {
+        std::uniform_int_distribution<int64_t> d(0, i);
+        std::swap(perm[i], perm[d(rng)]);
+      }
+      for (int64_t off = 0; off < n; off += batch) {
+        int64_t count = std::min(batch, n - off);
+        if (count < batch && drop_last) break;
+        Slot s;
+        s.count = count;
+        s.img.resize(static_cast<size_t>(count) * kImgBytes);
+        s.lbl.resize(static_cast<size_t>(count));
+        for (int64_t j = 0; j < count; ++j) {
+          int64_t src = perm[off + j];
+          std::memcpy(s.img.data() + j * kImgBytes, images + src * kImgBytes,
+                      kImgBytes);
+          s.lbl[j] = labels[src];
+        }
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] { return ready.size() < capacity || stop.load(); });
+        if (stop.load()) return;
+        ready.push(std::move(s));
+        cv_ready.notify_one();
+      }
+      ++epoch;
+    }
+  }
+};
+
+void* batcher_create(const uint8_t* images, const int32_t* labels, int64_t n,
+                     int64_t batch, uint64_t seed, int drop_last,
+                     int64_t prefetch_depth) {
+  if (n <= 0 || batch <= 0 || batch > n) return nullptr;
+  auto* b = new Batcher();
+  b->images = images;
+  b->labels = labels;
+  b->n = n;
+  b->batch = batch;
+  b->drop_last = drop_last != 0;
+  b->seed = seed;
+  b->epoch = 0;
+  b->capacity = static_cast<size_t>(prefetch_depth > 0 ? prefetch_depth : 2);
+  b->producer = std::thread([b] { b->run(); });
+  return b;
+}
+
+// Blocks until a batch is staged; copies it into the caller's buffers.
+// Returns the sample count (<= batch; < batch only for a non-dropped tail).
+int64_t batcher_next(void* handle, uint8_t* out_images, int32_t* out_labels) {
+  auto* b = static_cast<Batcher*>(handle);
+  Batcher::Slot s;
+  {
+    std::unique_lock<std::mutex> lk(b->mu);
+    b->cv_ready.wait(lk, [&] { return !b->ready.empty() || b->stop.load(); });
+    if (b->ready.empty()) return -1;  // stopped
+    s = std::move(b->ready.front());
+    b->ready.pop();
+    b->cv_space.notify_one();
+  }
+  std::memcpy(out_images, s.img.data(), s.img.size());
+  std::memcpy(out_labels, s.lbl.data(), s.lbl.size() * sizeof(int32_t));
+  return s.count;
+}
+
+void batcher_destroy(void* handle) {
+  auto* b = static_cast<Batcher*>(handle);
+  b->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    b->cv_ready.notify_all();
+    b->cv_space.notify_all();
+  }
+  if (b->producer.joinable()) b->producer.join();
+  delete b;
+}
+
+}  // extern "C"
