@@ -110,8 +110,13 @@ def _threads() -> int:
 
 
 def chw_to_hwc(flat: np.ndarray) -> np.ndarray:
-    """[n, 3072] CHW-plane uint8 -> [n, 32, 32, 3] HWC uint8."""
+    """[n, 3072] CHW-plane uint8 -> [n, 32, 32, 3] HWC uint8 (a flat/1-D
+    multiple of 3072 is reshaped, matching the numpy reshape(-1, ...))."""
     flat = np.ascontiguousarray(flat, np.uint8)
+    if flat.size % 3072 != 0:
+        raise ValueError(f"image buffer of {flat.size} bytes is not a "
+                         "multiple of 3072")
+    flat = flat.reshape(-1, 3072)
     n = flat.shape[0]
     lib = get_lib()
     if lib is None:
@@ -128,6 +133,11 @@ def decode_records(raw: np.ndarray, label_bytes: int) -> Tuple[np.ndarray, np.nd
     labels). Fine label = last label byte (cifar-100 records are
     [coarse, fine])."""
     raw = np.ascontiguousarray(raw, np.uint8)
+    if raw.ndim != 2 or raw.shape[1] != label_bytes + 3072:
+        raise ValueError(
+            f"records of shape {raw.shape} do not match label_bytes="
+            f"{label_bytes} (expected [n, {label_bytes + 3072}])"
+        )
     n = raw.shape[0]
     lib = get_lib()
     if lib is None:

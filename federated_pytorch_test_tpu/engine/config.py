@@ -80,6 +80,13 @@ class ExperimentConfig:
     # write a jax.profiler trace of each epoch here (TPU/host timelines)
     profile_dir: str | None = None
 
+    # failure detection (SURVEY.md §5 — absent in the reference): check
+    # per-client losses each epoch and per-client parameter finiteness
+    # each consensus round. 'warn' records a `fault` metric and continues
+    # (the optimizer's NaN guards already freeze a poisoned client);
+    # 'raise' aborts the run; 'off' skips the checks.
+    fault_mode: str = "warn"
+
     # flags (reference src/federated_trio.py:28-31)
     init_model: bool = True  # common-seed init across clients
     load_model: bool = False
@@ -96,6 +103,23 @@ class ExperimentConfig:
     eval_batch: int = 500
     checkpoint_dir: str = "./checkpoints"
     max_devices: int | None = None
+
+    def __post_init__(self):
+        if self.fault_mode not in ("warn", "raise", "off"):
+            raise ValueError(
+                f"fault_mode must be 'warn', 'raise' or 'off', "
+                f"got {self.fault_mode!r}"
+            )
+        if self.strategy not in ("none", "fedavg", "admm"):
+            raise ValueError(
+                f"strategy must be 'none', 'fedavg' or 'admm', "
+                f"got {self.strategy!r}"
+            )
+        if self.reg_mode not in ("active_linear", "first_linear", "none"):
+            raise ValueError(
+                f"reg_mode must be 'active_linear', 'first_linear' or "
+                f"'none', got {self.reg_mode!r}"
+            )
 
     def lbfgs_config(self) -> LBFGSConfig:
         return LBFGSConfig(

@@ -12,6 +12,7 @@ collective (see `engine/steps.py`).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -121,7 +122,9 @@ class Trainer:
         self._consensus_fns: Dict[int, Any] = {}
         self._init_fns: Dict[int, Any] = {}
         self._eval_fn = None
+        self._health_fn = None
         self._completed_nloops = 0
+        self._step_num = 0
 
         if cfg.load_model:
             self._restore()
@@ -243,9 +246,37 @@ class Trainer:
         total = int(np.asarray(self.test_mask).sum())
         return np.asarray(correct) / total
 
+    def _check_losses(self, losses: np.ndarray, **ctx) -> None:
+        """Per-epoch failure detection: a client whose losses went
+        non-finite is poisoned (the optimizer's NaN guards freeze its
+        params, reference src/lbfgsnew.py:542, but the fault must surface)."""
+        bad = np.where(~np.isfinite(losses).all(axis=0))[0]
+        if bad.size:
+            self.recorder.fault("nonfinite_loss", bad, **ctx)
+            if self.cfg.fault_mode == "raise":
+                raise FloatingPointError(
+                    f"non-finite training loss on clients {bad.tolist()} ({ctx})"
+                )
+
+    def _check_params(self, **ctx) -> None:
+        """Per-round failure detection: per-client parameter finiteness."""
+        if self._health_fn is None:
+            self._health_fn = jax.jit(
+                lambda f: jnp.isfinite(f).all(axis=tuple(range(1, f.ndim)))
+            )
+        ok = np.asarray(self._health_fn(self.flat))
+        bad = np.where(~ok)[0]
+        if bad.size:
+            self.recorder.fault("nonfinite_params", bad, **ctx)
+            if self.cfg.fault_mode == "raise":
+                raise FloatingPointError(
+                    f"non-finite parameters on clients {bad.tolist()} ({ctx})"
+                )
+
     def run_round(self, nloop: int, gid: int) -> None:
         """One partition group's full round: init, Nadmm x (epochs + consensus)."""
         cfg = self.cfg
+        check = cfg.fault_mode != "off"
         epoch_fn, consensus_fn, init_fn = self._fns(gid)
         lstate, y, z, rho, extra = init_fn(self.flat)
         gsize = self.partition.group_size(gid)
@@ -253,20 +284,33 @@ class Trainer:
         for nadmm in range(cfg.nadmm):
             for epoch in range(cfg.nepoch):
                 idx = self._epoch_indices(nloop, gid, nadmm, epoch)
-                self.flat, lstate, self.stats, losses = epoch_fn(
-                    self.flat,
-                    lstate,
-                    self.stats,
-                    self.shard_imgs,
-                    self.shard_labels,
-                    idx,
-                    self.mean,
-                    self.std,
-                    y,
-                    z,
-                    rho,
+                self._step_num += 1
+                t0 = time.perf_counter()
+                with jax.profiler.StepTraceAnnotation(
+                    "epoch", step_num=self._step_num
+                ):
+                    self.flat, lstate, self.stats, losses = epoch_fn(
+                        self.flat,
+                        lstate,
+                        self.stats,
+                        self.shard_imgs,
+                        self.shard_labels,
+                        idx,
+                        self.mean,
+                        self.std,
+                        y,
+                        z,
+                        rho,
+                    )
+                    losses = np.asarray(losses)  # [S, K] (blocks on device)
+                self.recorder.step_time(
+                    "epoch",
+                    time.perf_counter() - t0,
+                    nloop=nloop,
+                    group=gid,
+                    nadmm=nadmm,
+                    epoch=epoch,
                 )
-                losses = np.asarray(losses)  # [S, K]
                 for s in range(losses.shape[0]):
                     self.recorder.batch_losses(
                         losses[s],
@@ -275,6 +319,10 @@ class Trainer:
                         nadmm=nadmm,
                         epoch=epoch,
                         minibatch=s,
+                    )
+                if check:
+                    self._check_losses(
+                        losses, nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch
                     )
                 if cfg.strategy == "none" and cfg.check_results:
                     # independent training has no consensus round; eval per
@@ -285,10 +333,19 @@ class Trainer:
                         self.evaluate(), nloop=nloop, group=gid, nadmm=epoch
                     )
             if consensus_fn is not None:
-                self.flat, y, z, rho, extra, met = consensus_fn(
-                    self.flat, y, z, rho, extra, jnp.int32(nadmm)
+                t0 = time.perf_counter()
+                with jax.profiler.TraceAnnotation("consensus"):
+                    self.flat, y, z, rho, extra, met = consensus_fn(
+                        self.flat, y, z, rho, extra, jnp.int32(nadmm)
+                    )
+                    dual, primal, mean_rho = (np.asarray(m) for m in met)
+                self.recorder.step_time(
+                    "consensus",
+                    time.perf_counter() - t0,
+                    nloop=nloop,
+                    group=gid,
+                    nadmm=nadmm,
                 )
-                dual, primal, mean_rho = (np.asarray(m) for m in met)
                 is_admm = cfg.strategy == "admm"
                 self.recorder.residuals(
                     primal if is_admm else None,
@@ -299,6 +356,8 @@ class Trainer:
                     nadmm=nadmm,
                     group_size=gsize,
                 )
+            if check:
+                self._check_params(nloop=nloop, group=gid, nadmm=nadmm)
             if cfg.check_results:
                 self.recorder.accuracies(
                     self.evaluate(), nloop=nloop, group=gid, nadmm=nadmm

@@ -95,6 +95,29 @@ class MetricsRecorder:
                     f"{100.0 * a:.2f} %"
                 )
 
+    def step_time(self, phase: str, seconds: float, **context) -> None:
+        """Wall-clock duration of one phase (epoch / consensus / eval).
+
+        The tracing series the reference's dead `start_time = time.time()`
+        never produced (reference src/no_consensus_trio.py:6,175).
+        """
+        self.log("step_time", {"phase": phase, "seconds": seconds}, **context)
+        if self.verbose:
+            ctx = " ".join(f"{k}={v}" for k, v in context.items())
+            print(f"step_time phase={phase} {ctx} seconds={seconds:.4f}")
+
+    def fault(self, kind: str, clients, **context) -> None:
+        """A detected client fault (non-finite loss/params).
+
+        The failure-detection series the reference lacks entirely
+        (SURVEY.md §5: NaN guards exist only inside the optimizer).
+        """
+        ids = [int(c) for c in clients]
+        self.log("fault", {"kind": kind, "clients": ids}, **context)
+        if self.verbose:
+            ctx = " ".join(f"{k}={v}" for k, v in context.items())
+            print(f"FAULT kind={kind} clients={ids} {ctx}")
+
     def latest(self, name: str):
         return self.series[name][-1]["value"] if self.series.get(name) else None
 

@@ -130,8 +130,9 @@ struct Batcher {
   std::queue<Slot> ready;
   size_t capacity;
   std::mutex mu;
-  std::condition_variable cv_ready, cv_space;
+  std::condition_variable cv_ready, cv_space, cv_idle;
   std::atomic<bool> stop{false};
+  int active_consumers = 0;  // guarded by mu; drained before destruction
   std::thread producer;
 
   void run() {
@@ -186,30 +187,43 @@ void* batcher_create(const uint8_t* images, const int32_t* labels, int64_t n,
 }
 
 // Blocks until a batch is staged; copies it into the caller's buffers.
-// Returns the sample count (<= batch; < batch only for a non-dropped tail).
+// Returns the sample count (<= batch; < batch only for a non-dropped
+// tail), or -1 once the batcher is being destroyed.
 int64_t batcher_next(void* handle, uint8_t* out_images, int32_t* out_labels) {
   auto* b = static_cast<Batcher*>(handle);
   Batcher::Slot s;
   {
     std::unique_lock<std::mutex> lk(b->mu);
+    ++b->active_consumers;
     b->cv_ready.wait(lk, [&] { return !b->ready.empty() || b->stop.load(); });
-    if (b->ready.empty()) return -1;  // stopped
+    if (b->stop.load() && b->ready.empty()) {
+      // destroy() is waiting on cv_idle for us to leave before freeing b
+      --b->active_consumers;
+      b->cv_idle.notify_all();
+      return -1;
+    }
     s = std::move(b->ready.front());
     b->ready.pop();
     b->cv_space.notify_one();
+    --b->active_consumers;
+    b->cv_idle.notify_all();
   }
   std::memcpy(out_images, s.img.data(), s.img.size());
   std::memcpy(out_labels, s.lbl.data(), s.lbl.size() * sizeof(int32_t));
   return s.count;
 }
 
+// Safe against consumers concurrently blocked in batcher_next (e.g. a
+// GC-triggered close from another Python thread while the GIL is released
+// inside the ctypes call): they are woken and drained before the free.
 void batcher_destroy(void* handle) {
   auto* b = static_cast<Batcher*>(handle);
   b->stop.store(true);
   {
-    std::lock_guard<std::mutex> lk(b->mu);
+    std::unique_lock<std::mutex> lk(b->mu);
     b->cv_ready.notify_all();
     b->cv_space.notify_all();
+    b->cv_idle.wait(lk, [&] { return b->active_consumers == 0; });
   }
   if (b->producer.joinable()) b->producer.join();
   delete b;

@@ -147,6 +147,60 @@ def test_resnet_smoke_with_batch_stats():
     assert np.isfinite(stats).all()
 
 
+def test_config_rejects_invalid_enums():
+    for field, bad in [
+        ("fault_mode", "Raise"),
+        ("strategy", "fedsgd"),
+        ("reg_mode", "all"),
+    ]:
+        with pytest.raises(ValueError, match=field.split("_")[0]):
+            get_preset("fedavg", **{field: bad})
+
+
+def test_step_times_recorded():
+    cfg = tiny("fedavg", model="net", nadmm=1)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    tr.group_order = tr.group_order[:1]
+    rec = tr.run()
+    times = rec.series["step_time"]
+    phases = {t["value"]["phase"] for t in times}
+    assert phases == {"epoch", "consensus"}
+    assert all(t["value"]["seconds"] > 0 for t in times)
+
+
+def test_fault_detection_warn_and_raise():
+    import jax.numpy as jnp
+
+    # poison client 1's params with NaN before a round: fault_mode='warn'
+    # must record the fault (and the optimizer's guards keep siblings
+    # finite); fault_mode='raise' must abort
+    cfg = tiny("fedavg", model="net", nadmm=1, fault_mode="warn")
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    tr.flat = tr.flat.at[1].set(jnp.nan)
+    tr.group_order = tr.group_order[:1]
+    rec = tr.run()
+    faults = rec.series["fault"]
+    # the poisoned client is identified by the per-epoch loss check...
+    assert any(
+        f["value"]["kind"] == "nonfinite_loss" and f["value"]["clients"] == [1]
+        for f in faults
+    )
+    # ...and after the FedAvg mean propagates its NaN group coordinates to
+    # everyone (exactly what the reference's z=(x1+x2+x3)/3 would do), the
+    # per-round param check reports the blast radius
+    assert any(
+        f["value"]["kind"] == "nonfinite_params" and 1 in f["value"]["clients"]
+        for f in faults
+    )
+
+    cfg = tiny("fedavg", model="net", nadmm=1, fault_mode="raise")
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    tr.flat = tr.flat.at[1].set(jnp.nan)
+    tr.group_order = tr.group_order[:1]
+    with pytest.raises(FloatingPointError, match="clients \\[1\\]"):
+        tr.run()
+
+
 def test_scale64_preset_runs_on_8_devices():
     # BASELINE.json config #5: K=64 clients, CIFAR100, one client per core
     # on a v4-64. On the 8-device CPU mesh the 64 clients fold into local

@@ -18,7 +18,10 @@ def _native_available() -> bool:
     return native.get_lib() is not None
 
 
-pytestmark = pytest.mark.skipif(
+# applied per-test (NOT module-wide) so the fallback-contract test below
+# still runs on machines without a C++ toolchain — where the fallback IS
+# the production code path
+needs_native = pytest.mark.skipif(
     not _native_available(), reason="native loader unavailable (no g++?)"
 )
 
@@ -27,6 +30,7 @@ def _numpy_chw_to_hwc(flat):
     return flat.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
 
 
+@needs_native
 def test_chw_to_hwc_byte_identical():
     rng = np.random.default_rng(0)
     flat = rng.integers(0, 256, size=(257, 3072), dtype=np.uint8)
@@ -34,6 +38,7 @@ def test_chw_to_hwc_byte_identical():
 
 
 @pytest.mark.parametrize("label_bytes", [1, 2])
+@needs_native
 def test_decode_records_byte_identical(label_bytes):
     rng = np.random.default_rng(1)
     raw = rng.integers(0, 256, size=(133, label_bytes + 3072), dtype=np.uint8)
@@ -42,6 +47,7 @@ def test_decode_records_byte_identical(label_bytes):
     np.testing.assert_array_equal(img, _numpy_chw_to_hwc(raw[:, label_bytes:]))
 
 
+@needs_native
 def test_bin_archive_loader_uses_native(tmp_path):
     # a miniature cifar-10 binary archive: loader output must equal a
     # direct decode of the records
@@ -81,6 +87,7 @@ def _epoch_of(batcher, n, batch):
     return np.concatenate(imgs), np.concatenate(lbls)
 
 
+@needs_native
 def test_batcher_exactly_once_per_epoch():
     rng = np.random.default_rng(3)
     n, batch = 96, 16
@@ -95,6 +102,7 @@ def test_batcher_exactly_once_per_epoch():
     assert not np.array_equal(l1, l2)
 
 
+@needs_native
 def test_batcher_images_match_labels():
     # image rows must travel with their labels through the shuffle
     rng = np.random.default_rng(4)
@@ -107,6 +115,7 @@ def test_batcher_images_match_labels():
         np.testing.assert_array_equal(img[i], images[lbl[i]])
 
 
+@needs_native
 def test_batcher_deterministic_in_seed():
     rng = np.random.default_rng(5)
     n, batch = 48, 12
@@ -119,6 +128,7 @@ def test_batcher_deterministic_in_seed():
     np.testing.assert_array_equal(la, lb)
 
 
+@needs_native
 def test_batcher_tail_semantics():
     rng = np.random.default_rng(6)
     images = rng.integers(0, 256, size=(50, 32, 32, 3), dtype=np.uint8)
@@ -133,6 +143,7 @@ def test_batcher_tail_semantics():
     assert sorted(sizes) == [2, 16, 16, 16]
 
 
+@needs_native
 def test_batcher_rejects_oversized_batch():
     images = np.zeros((30, 32, 32, 3), np.uint8)
     labels = np.zeros((30,), np.int32)
@@ -140,6 +151,7 @@ def test_batcher_rejects_oversized_batch():
         native.PrefetchBatcher(images, labels, 64)
 
 
+@needs_native
 def test_batcher_closed_raises_stopiteration():
     images = np.zeros((32, 32, 32, 3), np.uint8)
     labels = np.zeros((32,), np.int32)
@@ -148,6 +160,20 @@ def test_batcher_closed_raises_stopiteration():
     b.close()
     with pytest.raises(StopIteration):
         next(b)
+
+
+def test_decode_shape_validation():
+    # mismatched record width must raise, not read out of bounds
+    raw = np.zeros((4, 3074), np.uint8)  # cifar-100 width
+    with pytest.raises(ValueError, match="label_bytes"):
+        native.decode_records(raw, 1)
+    with pytest.raises(ValueError, match="multiple of 3072"):
+        native.chw_to_hwc(np.zeros((10, 3000), np.uint8))
+    # a single flat image is accepted like numpy reshape(-1, ...) was
+    one = np.arange(3072, dtype=np.uint8)
+    np.testing.assert_array_equal(
+        native.chw_to_hwc(one), _numpy_chw_to_hwc(one[None])
+    )
 
 
 def test_numpy_fallback_same_contract():
