@@ -27,7 +27,14 @@ class ExperimentConfig:
     """
 
     name: str = "custom"
-    model: str = "net"  # net | net1 | net2 | resnet18 (models.MODELS)
+    model: str = "net"  # net | net1 | net2 | resnet18 | vit (models.MODELS)
+    # 'bfloat16' runs convs/matmuls in bf16 on the MXU (params, norms,
+    # the loss, and ALL L-BFGS math stay f32 — mixed precision, not low
+    # precision). 'float32' matches the reference bit-for-bit in spirit.
+    # Measured on one real chip: bf16 LOSES ~1.6x on ResNet18 @ batch 32
+    # (the f32-norm cast boundaries outweigh MXU gains at this size), so
+    # f32 stays the default; the knob matters for larger models/batches.
+    compute_dtype: str = "float32"
     dataset: str = "cifar10"  # cifar10 | cifar100
     data_root: str | None = None  # None => $CIFAR_DATA_DIR or ./torchdata
     synthetic_ok: bool = True  # fall back to synthetic data if no archive
@@ -105,6 +112,11 @@ class ExperimentConfig:
     max_devices: int | None = None
 
     def __post_init__(self):
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.compute_dtype!r}"
+            )
         if self.fault_mode not in ("warn", "raise", "off"):
             raise ValueError(
                 f"fault_mode must be 'warn', 'raise' or 'off', "

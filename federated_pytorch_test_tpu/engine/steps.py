@@ -90,7 +90,12 @@ def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labe
     else:
         logits = ctx.model.apply({"params": params}, images, train=True)
         new_stats = stats
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    # loss always in f32: under compute_dtype=bfloat16 the logits arrive
+    # bf16, and the softmax/CE must not round (L-BFGS line-search decisions
+    # compare loss values at 1e-9 tolerances)
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
     return loss, new_stats
 
 

@@ -67,11 +67,13 @@ class Trainer:
         self.mesh = largest_feasible_mesh(cfg.n_clients, cfg.max_devices)
 
         model_cls = MODELS[cfg.model]
-        self.model = (
-            model_cls(num_classes=self.fed.num_classes)
-            if "num_classes" in getattr(model_cls, "__dataclass_fields__", {})
-            else model_cls()
-        )
+        fields = getattr(model_cls, "__dataclass_fields__", {})
+        kw = {}
+        if "num_classes" in fields:
+            kw["num_classes"] = self.fed.num_classes
+        if "dtype" in fields:
+            kw["dtype"] = jnp.dtype(cfg.compute_dtype)
+        self.model = model_cls(**kw)
 
         variables = self._init_variables()
         params_t = jax.tree.map(lambda x: x[0], variables["params"])

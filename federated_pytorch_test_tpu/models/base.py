@@ -27,7 +27,13 @@ class PartitionedModel(nn.Module):
       GROUP_PATHS:      per-group list of path prefixes into the params tree
       LINEAR_GROUP_IDS: groups that receive L1/L2 regularization
       TRAIN_ORDER:      default group visit order per outer loop
+
+    Every model carries a `dtype` compute-dtype field (declared here once):
+    params stay f32; convs/matmuls run in `dtype` (the engine's
+    `compute_dtype` knob) while norms and the loss stay f32.
     """
+
+    dtype: Any = jnp.float32
 
     # NOTE: deliberately un-annotated so linen's dataclass transform treats
     # them as plain class attributes, not module fields.

@@ -22,6 +22,8 @@ running stats; see SURVEY.md §7 hard part 5).
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -32,7 +34,7 @@ from federated_pytorch_test_tpu.models.base import (
 )
 
 
-def _conv(features: int, kernel: int, stride: int, name: str) -> nn.Conv:
+def _conv(features: int, kernel: int, stride: int, name: str, dtype=None) -> nn.Conv:
     return nn.Conv(
         features=features,
         kernel_size=(kernel, kernel),
@@ -41,15 +43,19 @@ def _conv(features: int, kernel: int, stride: int, name: str) -> nn.Conv:
         use_bias=False,
         name=name,
         kernel_init=kernel_init,
+        dtype=dtype,
     )
 
 
 def _bn(name: str, train: bool) -> nn.BatchNorm:
+    # normalization always runs in f32 (mixed-precision recipe: cheap
+    # elementwise math in full precision, matmuls/convs in compute dtype)
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-5,
         name=name,
+        dtype=jnp.float32,
     )
 
 
@@ -61,15 +67,17 @@ class BasicBlock(nn.Module):
 
     planes: int
     stride: int = 1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         in_planes = x.shape[-1]
-        out = nn.elu(_bn("bn1", train)(_conv(self.planes, 3, self.stride, "conv1")(x)))
-        out = _bn("bn2", train)(_conv(self.planes, 3, 1, "conv2")(out))
+        dt = self.dtype
+        out = nn.elu(_bn("bn1", train)(_conv(self.planes, 3, self.stride, "conv1", dt)(x)))
+        out = _bn("bn2", train)(_conv(self.planes, 3, 1, "conv2", dt)(out))
         if self.stride != 1 or in_planes != self.planes:
-            x = _bn("sc_bn", train)(_conv(self.planes, 1, self.stride, "sc_conv")(x))
-        return nn.elu(out + x)
+            x = _bn("sc_bn", train)(_conv(self.planes, 1, self.stride, "sc_conv", dt)(x))
+        return nn.elu(out + x.astype(out.dtype))
 
 
 class ResNet18(PartitionedModel):
@@ -112,13 +120,14 @@ class ResNet18(PartitionedModel):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = nn.elu(_bn("bn1", train)(_conv(64, 3, 1, "conv1")(x)))
+        x = nn.elu(_bn("bn1", train)(_conv(64, 3, 1, "conv1", self.dtype)(x)))
         for i, (planes, stride) in enumerate(self.STAGES):
-            x = BasicBlock(planes=planes, stride=stride, name=f"block{i}")(
-                x, train=train
-            )
+            x = BasicBlock(
+                planes=planes, stride=stride, dtype=self.dtype, name=f"block{i}"
+            )(x, train=train)
         x = nn.avg_pool(x, window_shape=(4, 4), strides=(4, 4))  # 4x4 -> 1x1
         x = x.reshape((x.shape[0], -1))
         return nn.Dense(
-            self.num_classes, name="linear", kernel_init=kernel_init, bias_init=bias_init
+            self.num_classes, name="linear", kernel_init=kernel_init,
+            bias_init=bias_init, dtype=self.dtype,
         )(x)

@@ -10,6 +10,8 @@ reference's `unfreeze_one_layer` convention of `ci == 2*layer_id`
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -20,7 +22,7 @@ from federated_pytorch_test_tpu.models.base import (
 )
 
 
-def _conv(features: int, kernel: int, padding: str, name: str) -> nn.Conv:
+def _conv(features: int, kernel: int, padding: str, name: str, dtype=None) -> nn.Conv:
     return nn.Conv(
         features=features,
         kernel_size=(kernel, kernel),
@@ -28,12 +30,14 @@ def _conv(features: int, kernel: int, padding: str, name: str) -> nn.Conv:
         name=name,
         kernel_init=kernel_init,
         bias_init=bias_init,
+        dtype=dtype,
     )
 
 
-def _dense(features: int, name: str) -> nn.Dense:
+def _dense(features: int, name: str, dtype=None) -> nn.Dense:
     return nn.Dense(
-        features=features, name=name, kernel_init=kernel_init, bias_init=bias_init
+        features=features, name=name, kernel_init=kernel_init,
+        bias_init=bias_init, dtype=dtype,
     )
 
 
@@ -54,12 +58,13 @@ class Net(PartitionedModel):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = _maxpool(nn.elu(_conv(6, 5, "VALID", "conv1")(x)))  # 32->28->14
-        x = _maxpool(nn.elu(_conv(16, 5, "VALID", "conv2")(x)))  # 14->10->5
+        dt = self.dtype
+        x = _maxpool(nn.elu(_conv(6, 5, "VALID", "conv1", dt)(x)))  # 32->28->14
+        x = _maxpool(nn.elu(_conv(16, 5, "VALID", "conv2", dt)(x)))  # 14->10->5
         x = x.reshape((x.shape[0], -1))  # 5*5*16 = 400
-        x = nn.elu(_dense(120, "fc1")(x))
-        x = nn.elu(_dense(84, "fc2")(x))
-        return _dense(self.num_classes, "fc3")(x)
+        x = nn.elu(_dense(120, "fc1", dt)(x))
+        x = nn.elu(_dense(84, "fc2", dt)(x))
+        return _dense(self.num_classes, "fc3", dt)(x)
 
 
 class Net1(PartitionedModel):
@@ -76,15 +81,16 @@ class Net1(PartitionedModel):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = nn.elu(_conv(32, 3, "VALID", "conv1")(x))  # 32->30
-        x = nn.elu(_conv(32, 3, "VALID", "conv2")(x))  # 30->28
+        dt = self.dtype
+        x = nn.elu(_conv(32, 3, "VALID", "conv1", dt)(x))  # 32->30
+        x = nn.elu(_conv(32, 3, "VALID", "conv2", dt)(x))  # 30->28
         x = _maxpool(x)  # 28->14
-        x = nn.elu(_conv(64, 3, "VALID", "conv3")(x))  # 14->12
-        x = nn.elu(_conv(64, 3, "VALID", "conv4")(x))  # 12->10
+        x = nn.elu(_conv(64, 3, "VALID", "conv3", dt)(x))  # 14->12
+        x = nn.elu(_conv(64, 3, "VALID", "conv4", dt)(x))  # 12->10
         x = _maxpool(x)  # 10->5
         x = x.reshape((x.shape[0], -1))  # 5*5*64 = 1600
-        x = nn.elu(_dense(512, "fc1")(x))
-        return _dense(self.num_classes, "fc2")(x)
+        x = nn.elu(_dense(512, "fc1", dt)(x))
+        return _dense(self.num_classes, "fc2", dt)(x)
 
 
 class Net2(PartitionedModel):
@@ -111,13 +117,14 @@ class Net2(PartitionedModel):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = _maxpool(nn.elu(_conv(64, 3, "SAME", "conv1")(x)))  # 32->16
-        x = _maxpool(nn.elu(_conv(128, 3, "SAME", "conv2")(x)))  # 16->8
-        x = _maxpool(nn.elu(_conv(256, 3, "SAME", "conv3")(x)))  # 8->4
-        x = _maxpool(nn.elu(_conv(512, 3, "SAME", "conv4")(x)))  # 4->2
+        dt = self.dtype
+        x = _maxpool(nn.elu(_conv(64, 3, "SAME", "conv1", dt)(x)))  # 32->16
+        x = _maxpool(nn.elu(_conv(128, 3, "SAME", "conv2", dt)(x)))  # 16->8
+        x = _maxpool(nn.elu(_conv(256, 3, "SAME", "conv3", dt)(x)))  # 8->4
+        x = _maxpool(nn.elu(_conv(512, 3, "SAME", "conv4", dt)(x)))  # 4->2
         x = x.reshape((x.shape[0], -1))  # 2*2*512 = 2048
-        x = nn.elu(_dense(128, "fc1")(x))
-        x = nn.elu(_dense(256, "fc2")(x))
-        x = nn.elu(_dense(512, "fc3")(x))
-        x = nn.elu(_dense(1024, "fc4")(x))
-        return _dense(self.num_classes, "fc5")(x)
+        x = nn.elu(_dense(128, "fc1", dt)(x))
+        x = nn.elu(_dense(256, "fc2", dt)(x))
+        x = nn.elu(_dense(512, "fc3", dt)(x))
+        x = nn.elu(_dense(1024, "fc4", dt)(x))
+        return _dense(self.num_classes, "fc5", dt)(x)

@@ -17,6 +17,8 @@ a property of the call context (mesh + shard_map), not of the model.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -40,22 +42,30 @@ class MultiHeadAttention(nn.Module):
     attn_impl: str = "dense"  # 'dense' | 'ring'
     causal: bool = False
     seq_axis: str = SEQ_AXIS
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         b, s, _ = x.shape
         h, hd = self.num_heads, self.dim // self.num_heads
         qkv = nn.Dense(
-            3 * self.dim, name="qkv", kernel_init=kernel_init, bias_init=bias_init
+            3 * self.dim, name="qkv", kernel_init=kernel_init,
+            bias_init=bias_init, dtype=self.dtype,
         )(x)
-        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, hd), 3, axis=2)
+        # attention core in f32: the online softmax must not lose mass to
+        # bf16 rounding (projections carry the compute dtype; the core is
+        # a small fraction of the FLOPs at these widths)
+        q, k, v = jnp.split(
+            qkv.reshape(b, s, 3 * h, hd).astype(jnp.float32), 3, axis=2
+        )
         if self.attn_impl == "ring":
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
         else:
             out = dense_attention(q, k, v, causal=self.causal)
         out = out.reshape(b, s, self.dim)
         return nn.Dense(
-            self.dim, name="proj", kernel_init=kernel_init, bias_init=bias_init
+            self.dim, name="proj", kernel_init=kernel_init,
+            bias_init=bias_init, dtype=self.dtype,
         )(out)
 
 
@@ -67,27 +77,31 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     attn_impl: str = "dense"
     causal: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        y = nn.LayerNorm(name="ln1")(x)
+        y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x)
         x = x + MultiHeadAttention(
             self.dim,
             self.num_heads,
             attn_impl=self.attn_impl,
             causal=self.causal,
+            dtype=self.dtype,
             name="attn",
         )(y)
-        y = nn.LayerNorm(name="ln2")(x)
+        y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
         y = nn.Dense(
             self.mlp_ratio * self.dim,
             name="fc1",
             kernel_init=kernel_init,
             bias_init=bias_init,
+            dtype=self.dtype,
         )(y)
         y = nn.gelu(y)
         y = nn.Dense(
-            self.dim, name="fc2", kernel_init=kernel_init, bias_init=bias_init
+            self.dim, name="fc2", kernel_init=kernel_init,
+            bias_init=bias_init, dtype=self.dtype,
         )(y)
         return x + y
 
@@ -132,6 +146,7 @@ class ViT(PartitionedModel):
             name="embed",
             kernel_init=kernel_init,
             bias_init=bias_init,
+            dtype=self.dtype,
         )(x)  # [B, 8, 8, dim]
         x = x.reshape(b, -1, self.dim)  # [B, 64, dim]
         pos = self.param(
@@ -143,10 +158,12 @@ class ViT(PartitionedModel):
                 self.dim,
                 self.num_heads,
                 attn_impl=self.attn_impl,
+                dtype=self.dtype,
                 name=f"block{i}",
             )(x)
-        x = nn.LayerNorm(name="ln_out")(x)
+        x = nn.LayerNorm(name="ln_out", dtype=jnp.float32)(x)
         x = jnp.mean(x, axis=1)  # mean-pool tokens
         return nn.Dense(
-            self.num_classes, name="head", kernel_init=kernel_init, bias_init=bias_init
+            self.num_classes, name="head", kernel_init=kernel_init,
+            bias_init=bias_init, dtype=self.dtype,
         )(x)

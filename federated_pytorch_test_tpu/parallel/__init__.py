@@ -24,6 +24,7 @@ from federated_pytorch_test_tpu.parallel.ring import (
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
+    client_seq_mesh,
     client_sharding,
     largest_feasible_mesh,
     mesh_size,
@@ -43,6 +44,7 @@ __all__ = [
     "client_count",
     "client_mean",
     "client_mesh",
+    "client_seq_mesh",
     "client_sharding",
     "client_sum",
     "group_distances",

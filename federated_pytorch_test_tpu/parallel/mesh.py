@@ -45,6 +45,30 @@ def client_mesh(
     return Mesh(np.asarray(devs), (CLIENT_AXIS,))
 
 
+def client_seq_mesh(
+    d_clients: int, d_seq: int, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """A 2-D `(clients, seq)` mesh: federated parallelism composed with
+    sequence/context parallelism.
+
+    Each client block owns a `d_seq`-device ring for ring attention
+    (`parallel/ring.py`) while consensus collectives still reduce over the
+    `clients` axis — the two communication patterns ride disjoint mesh
+    axes, so neither collective sees the other's traffic. The axis order
+    puts `seq` innermost (fastest-varying device index = physically
+    adjacent on most topologies), which is where the ring's per-step
+    `ppermute` bandwidth matters.
+    """
+    from federated_pytorch_test_tpu.parallel.ring import SEQ_AXIS
+
+    devs = list(devices) if devices is not None else jax.devices()
+    need = d_clients * d_seq
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, only {len(devs)} available")
+    grid = np.asarray(devs[:need]).reshape(d_clients, d_seq)
+    return Mesh(grid, (CLIENT_AXIS, SEQ_AXIS))
+
+
 def mesh_size(mesh: Mesh) -> int:
     return mesh.shape[CLIENT_AXIS]
 

@@ -147,6 +147,19 @@ def test_resnet_smoke_with_batch_stats():
     assert np.isfinite(stats).all()
 
 
+def test_bfloat16_compute_trains():
+    # mixed precision: convs/matmuls bf16, params + loss + L-BFGS f32
+    cfg = tiny("fedavg", model="net", nadmm=2, compute_dtype="bfloat16")
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    assert np.asarray(tr.flat).dtype == np.float32  # params stay f32
+    tr.group_order = tr.group_order[:2]
+    rec = tr.run()
+    losses = rec.series["train_loss"]
+    first, last = np.mean(losses[0]["value"]), np.mean(losses[-1]["value"])
+    assert np.isfinite(last) and last < first
+    assert "fault" not in rec.series  # no non-finite anything
+
+
 def test_config_rejects_invalid_enums():
     for field, bad in [
         ("fault_mode", "Raise"),

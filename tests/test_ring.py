@@ -113,6 +113,67 @@ def test_seq_parallel_block_stack_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+def test_client_seq_mesh_composes_federated_and_ring():
+    # 2 clients x 4-device sequence rings on one 2-D mesh: each client
+    # runs a ring-attention transformer block on its own params over its
+    # own sequence shard, then a client-axis collective averages a
+    # statistic — both communication patterns in ONE shard_map, matching
+    # the per-client dense reference exactly
+    from federated_pytorch_test_tpu.models.transformer import Block
+    from federated_pytorch_test_tpu.parallel import (
+        CLIENT_AXIS,
+        client_mean,
+        client_seq_mesh,
+    )
+
+    if len(jax.devices()) < 8:
+        pytest.skip("need 8 devices")
+    mesh = client_seq_mesh(2, 4)
+
+    rng = np.random.default_rng(7)
+    k, b, s, dim = 2, 2, 32, 16
+    x = jnp.asarray(rng.normal(size=(k, b, s, dim)), jnp.float32)
+
+    dense_blk = Block(dim, 4, attn_impl="dense", name="b0")
+    ring_blk = Block(dim, 4, attn_impl="ring", name="b0")
+    params = jax.vmap(
+        lambda key: dense_blk.init(key, x[0])
+    )(jax.random.split(jax.random.PRNGKey(0), k))  # per-client params
+
+    ref = jnp.stack(
+        [
+            dense_blk.apply(jax.tree.map(lambda p: p[i], params), x[i])
+            for i in range(k)
+        ]
+    )
+    ref_stat = jnp.mean(jnp.sum(ref**2, axis=(1, 2, 3)))
+
+    def body(params_loc, xs):
+        # [1, b, s/4, dim] local shard; one client per mesh row
+        out = ring_blk.apply(jax.tree.map(lambda p: p[0], params_loc), xs[0])
+        stat = client_mean(
+            jnp.sum(out**2)[None, None], axis_name=CLIENT_AXIS
+        )  # [1]: psum over clients of this device's seq-shard partial
+        return out[None], stat
+
+    pspec = jax.tree.map(lambda _: P(CLIENT_AXIS), params)
+    out, stat = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspec, P(CLIENT_AXIS, None, SEQ_AXIS, None)),
+        out_specs=(P(CLIENT_AXIS, None, SEQ_AXIS, None), P((CLIENT_AXIS, SEQ_AXIS))),
+    )(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    # stat: each device computed mean over clients of its seq-shard's
+    # partial sum; summing the 2 identical client rows x 4 shard partials
+    # recovers the global statistic
+    np.testing.assert_allclose(
+        float(np.asarray(stat).reshape(2, 4)[0].sum()),
+        float(ref_stat),
+        rtol=2e-4,
+    )
+
+
 def test_vit_partition_and_forward():
     from federated_pytorch_test_tpu.models import ViT
 
