@@ -14,8 +14,11 @@ order — replacing the reference's `linear_layer_ids` /
 from federated_pytorch_test_tpu.models.base import PartitionedModel, init_client_params
 from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
 from federated_pytorch_test_tpu.models.resnet import ResNet18
-from federated_pytorch_test_tpu.models.transformer import ViT
+from federated_pytorch_test_tpu.models.transformer import TransformerLM, ViT
 
+# the image-classification families the CIFAR engine can drive; the
+# token-based TransformerLM trains through the optimizer/partition APIs
+# directly (tests/test_ring.py long-context tests)
 MODELS = {
     "net": Net,
     "net1": Net1,
@@ -29,6 +32,7 @@ __all__ = [
     "Net1",
     "Net2",
     "ResNet18",
+    "TransformerLM",
     "ViT",
     "PartitionedModel",
     "init_client_params",

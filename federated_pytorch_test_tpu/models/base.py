@@ -55,6 +55,11 @@ class PartitionedModel(nn.Module):
     def input_shape(cls) -> Tuple[int, int, int]:
         return (32, 32, 3)
 
+    def dummy_input(self) -> jnp.ndarray:
+        """A minimal batch for `init`. Image models derive it from
+        `input_shape`; token models (TransformerLM) override both."""
+        return jnp.zeros((1,) + tuple(self.input_shape()), jnp.float32)
+
 
 def init_client_params(model: nn.Module, n_clients: int, seed: int = 0) -> PyTree:
     """Initialize K identical clients (common-seed init).
@@ -67,9 +72,18 @@ def init_client_params(model: nn.Module, n_clients: int, seed: int = 0) -> PyTre
     Returns the full variables dict with every leaf shaped `[K, ...]`
     (including e.g. `batch_stats` collections for BatchNorm models).
     """
+    import inspect
+
     rng = jax.random.PRNGKey(seed)
-    dummy = jnp.zeros((1,) + tuple(model.input_shape()), jnp.float32)
-    variables = model.init(rng, dummy, train=False)
+    dummy = (
+        model.dummy_input()
+        if hasattr(model, "dummy_input")
+        else jnp.zeros((1,) + tuple(model.input_shape()), jnp.float32)
+    )
+    kwargs = {}
+    if "train" in inspect.signature(model.__call__).parameters:
+        kwargs["train"] = False
+    variables = model.init(rng, dummy, **kwargs)
     return jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n_clients,) + x.shape), variables
     )

@@ -106,6 +106,88 @@ class Block(nn.Module):
         return x + y
 
 
+class TransformerLM(PartitionedModel):
+    """Causal decoder LM — the long-context member of the model family.
+
+    Token embedding + learned positions, 4 pre-norm causal blocks, tied
+    to nothing (separate head). Positions are an EXPLICIT input: under
+    sequence parallelism each device holds a contiguous token shard and
+    passes its global positions, so the same module runs unsharded
+    (`positions=None` → arange) or inside a `seq`-axis shard_map with
+    `attn_impl='ring'` — long context is a property of the call site,
+    not a fork of the model.
+
+    Partition groups mirror ViT's: (embeddings), each block (last one
+    carries the pre-head norm), head alone (the regularizable group).
+    """
+
+    GROUP_PATHS = (
+        (("embed",), ("pos_embed",)),
+        (("block0",),),
+        (("block1",),),
+        (("block2",),),
+        (("block3",), ("ln_out",)),
+        (("head",),),
+    )
+    LINEAR_GROUP_IDS = (5,)
+    TRAIN_ORDER = (0, 1, 2, 3, 4, 5)
+
+    vocab: int = 256
+    dim: int = 64
+    depth: int = 4  # must match the 4 block groups above
+    num_heads: int = 4
+    max_len: int = 2048
+    attn_impl: str = "dense"
+
+    @classmethod
+    def input_shape(cls):
+        raise NotImplementedError(
+            "TransformerLM consumes int32 token ids, not images; use "
+            "dummy_input() (init_client_params does)"
+        )
+
+    def dummy_input(self) -> jnp.ndarray:
+        return jnp.zeros((1, min(64, self.max_len)), jnp.int32)
+
+    @nn.compact
+    def __call__(
+        self, tokens: jnp.ndarray, positions: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        assert self.depth == 4, "GROUP_PATHS pins depth=4; add groups to change"
+        if positions is None:
+            if tokens.shape[1] > self.max_len:
+                raise ValueError(
+                    f"sequence length {tokens.shape[1]} exceeds max_len="
+                    f"{self.max_len}; jnp.take would silently clamp "
+                    "positions (raise max_len or pass explicit positions)"
+                )
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        # explicit positions (the sequence-parallel path) are the caller's
+        # contract: they must be < max_len
+        x = nn.Embed(
+            self.vocab, self.dim, name="embed",
+            embedding_init=nn.initializers.normal(0.02),
+        )(tokens)
+        pos_table = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (self.max_len, self.dim)
+        )
+        x = x + jnp.take(pos_table, positions, axis=0)
+        for i in range(self.depth):
+            x = Block(
+                self.dim,
+                self.num_heads,
+                attn_impl=self.attn_impl,
+                causal=True,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(name="ln_out", dtype=jnp.float32)(x)
+        return nn.Dense(
+            self.vocab, name="head", kernel_init=kernel_init,
+            bias_init=bias_init, dtype=self.dtype,
+        )(x)
+
+
 class ViT(PartitionedModel):
     """Tiny vision transformer for 32x32 inputs (4x4 patches, 64 tokens).
 

@@ -21,6 +21,10 @@ from federated_pytorch_test_tpu.parallel.ring import (
     seq_shard,
     seq_unshard,
 )
+from federated_pytorch_test_tpu.parallel.multihost import (
+    initialize_distributed,
+    multihost_client_mesh,
+)
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
@@ -48,7 +52,9 @@ __all__ = [
     "client_sharding",
     "client_sum",
     "group_distances",
+    "initialize_distributed",
     "largest_feasible_mesh",
+    "multihost_client_mesh",
     "mesh_size",
     "replicate",
     "replicated_sharding",

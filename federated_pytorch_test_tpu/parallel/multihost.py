@@ -1,0 +1,133 @@
+"""Multi-host setup: process initialization and DCN-spanning client meshes.
+
+The reference is strictly single-process (SURVEY.md §2.4 — no
+torch.distributed, no sockets). This framework scales the `clients` axis
+past one host the JAX way:
+
+* every host runs the SAME program; `initialize_distributed()` wires the
+  processes together (coordinator discovery via the standard TPU
+  environment, or explicit arguments elsewhere);
+* `multihost_client_mesh(K)` builds the client mesh over ALL processes'
+  devices, DCN-aware: with `jax.experimental.mesh_utils`'s hybrid layout
+  the client axis is ordered so that the clients of one slice are
+  ICI-adjacent and the slice boundary (DCN) is crossed as few times as
+  possible — consensus `psum`s then reduce within slices first and cross
+  DCN once, which is exactly the weighted-mean collective's reduction
+  shape (parallel/collectives.py).
+
+Single-process (the dev box, CI's virtual CPU mesh) everything degrades
+to the plain `client_mesh` — the same code runs everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    largest_feasible_mesh,
+)
+
+def _env_signals_multihost() -> bool:
+    """True when the environment describes MORE than this one process.
+
+    A coordinator address always does; `TPU_WORKER_HOSTNAMES` only when
+    it lists several workers — single-worker setups (including tunneled
+    dev chips) carry a one-entry list and are NOT multi-host.
+    """
+    if any(
+        v in os.environ
+        for v in ("COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+    ):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Initialize JAX's multi-process runtime; returns this process' id.
+
+    On TPU pods with standard environment variables, call with no
+    arguments on every host, BEFORE any other JAX call (touching the
+    backend first makes `jax.distributed.initialize` impossible — even
+    `jax.devices()` counts). A no-op (returning 0) when single-process
+    (nothing configured and no arguments given).
+
+    When a multi-host run IS configured, an initialization failure
+    raises: continuing would leave every host training the whole job
+    independently, racing on checkpoints — worse than a loud crash.
+    """
+    # decide from env/args alone — probing jax.process_count() here would
+    # itself initialize the backend and break the multi-process path
+    if coordinator_address is None and num_processes is None:
+        if not _env_signals_multihost():
+            return 0  # single-process run
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already" in str(e).lower():  # double-initialize: benign
+            return jax.process_index()
+        raise
+    return jax.process_index()
+
+
+def _num_slices() -> int:
+    """Number of ICI-connected slices (DCN islands) in the global topology.
+
+    TPU devices expose `slice_index`; one process per... is NOT assumed —
+    multi-host single-slice pods report one slice even with many
+    processes. Non-TPU backends count as a single slice.
+    """
+    indices = {getattr(d, "slice_index", 0) for d in jax.devices()}
+    return max(1, len(indices))
+
+
+def multihost_client_mesh(n_clients: int) -> Mesh:
+    """A 1-D `clients` mesh over every device of every process, laid out
+    DCN-aware when multiple slices are present.
+
+    Single-process: identical to `largest_feasible_mesh` (the largest
+    local device count dividing K). Multi-process: all global devices
+    participate, so `n_clients` must be a multiple of the global device
+    count (each device carries a K/D local client block).
+    """
+    if jax.process_count() == 1:
+        return largest_feasible_mesh(n_clients)
+
+    n_global = len(jax.devices())
+    if n_clients % n_global != 0:
+        raise ValueError(
+            f"multi-process mesh uses all {n_global} global devices; "
+            f"n_clients={n_clients} must be a multiple of that"
+        )
+
+    from jax.experimental import mesh_utils
+
+    n_slices = _num_slices()
+    per_slice = n_global // n_slices
+    if n_slices > 1 and n_slices * per_slice == n_global:
+        try:
+            devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(per_slice,),
+                dcn_mesh_shape=(n_slices,),
+            )
+            return Mesh(np.asarray(devices).reshape(-1), (CLIENT_AXIS,))
+        except (ValueError, AssertionError) as e:
+            warnings.warn(
+                f"hybrid mesh layout unavailable ({e}); falling back to "
+                "default device order"
+            )
+    return Mesh(np.asarray(jax.devices()), (CLIENT_AXIS,))

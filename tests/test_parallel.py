@@ -119,3 +119,21 @@ def test_group_distances_matches_numpy():
         for s in [part.groups[0][0], part.groups[1][0]]
     ]
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_multihost_helpers_single_process():
+    # single-process: initialize is a no-op returning 0; the multihost
+    # mesh degrades to the plain client mesh over local devices
+    import jax
+
+    from federated_pytorch_test_tpu.parallel import (
+        initialize_distributed,
+        mesh_size,
+        multihost_client_mesh,
+    )
+
+    assert initialize_distributed() == 0
+    m = multihost_client_mesh(8)
+    assert mesh_size(m) == min(8, len(jax.devices()))
+    m = multihost_client_mesh(6)  # 6 clients on 8 devices -> 6-device mesh
+    assert 6 % mesh_size(m) == 0
