@@ -1,0 +1,32 @@
+"""K=64 clients on a TPU pod: one client per core, DCN-aware mesh.
+
+Run THIS SAME script on every host of the slice/pod (standard JAX
+multi-controller SPMD). `initialize_distributed()` must run before any
+other JAX call; `multihost_client_mesh` lays the `clients` axis out so a
+slice's clients are ICI-adjacent and consensus psums cross DCN once.
+
+Single-host (or the dev box) it degrades gracefully: the mesh shrinks to
+the local devices and the same code runs.
+"""
+
+from federated_pytorch_test_tpu.parallel import (
+    initialize_distributed,
+    multihost_client_mesh,
+)
+
+proc = initialize_distributed()  # BEFORE any other JAX call
+
+from federated_pytorch_test_tpu.engine import Trainer, get_preset  # noqa: E402
+
+
+def main():
+    cfg = get_preset("fedavg_scale64")  # K=64 ResNet18 CIFAR100 (BASELINE #5)
+    mesh = multihost_client_mesh(cfg.n_clients)
+    trainer = Trainer(cfg, verbose=(proc == 0), mesh=mesh)
+    recorder = trainer.run()
+    if proc == 0:
+        recorder.save("scale64_metrics.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,10 @@ class ExperimentConfig:
     # (the f32-norm cast boundaries outweigh MXU gains at this size), so
     # f32 stays the default; the knob matters for larger models/batches.
     compute_dtype: str = "float32"
+    # rematerialize the forward during backprop (jax.checkpoint): trades
+    # ~1/3 more FLOPs for activation memory — the lever for batch sizes /
+    # models that do not fit HBM otherwise
+    remat: bool = False
     dataset: str = "cifar10"  # cifar10 | cifar100
     data_root: str | None = None  # None => $CIFAR_DATA_DIR or ./torchdata
     synthetic_ok: bool = True  # fall back to synthetic data if no archive

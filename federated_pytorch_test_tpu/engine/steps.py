@@ -76,6 +76,8 @@ class GroupContext(NamedTuple):
     reg_segments: Tuple = ()
     lambda1: float = 1e-4
     lambda2: float = 1e-4
+    # rematerialize the forward in the backward pass (jax.checkpoint)
+    remat: bool = False
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
@@ -131,6 +133,11 @@ def _client_train_step(ctx: GroupContext):
             if ctx.strategy == "admm":
                 loss = loss + admm_penalty(x, y, z, rho)
             return loss
+
+        if ctx.remat:
+            # grad recomputes the forward instead of keeping activations —
+            # every line-search probe is forward-only and unaffected
+            loss_fn = jax.checkpoint(loss_fn)
 
         x0 = ctx.partition.extract(flat, ctx.gid)
         x1, lstate, aux = lbfgs_step(loss_fn, x0, lstate, ctx.lbfgs)

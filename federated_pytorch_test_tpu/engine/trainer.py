@@ -32,6 +32,7 @@ from federated_pytorch_test_tpu.models import MODELS
 from federated_pytorch_test_tpu.parallel import (
     client_sharding,
     largest_feasible_mesh,
+    mesh_size,
     replicated_sharding,
 )
 from federated_pytorch_test_tpu.partition import (
@@ -55,7 +56,12 @@ def _epoch_seed(base: int, *parts: int) -> np.random.Generator:
 class Trainer:
     """Builds all device state and step functions for one experiment."""
 
-    def __init__(self, cfg: ExperimentConfig, verbose: bool = True, source=None):
+    def __init__(
+        self, cfg: ExperimentConfig, verbose: bool = True, source=None, mesh=None
+    ):
+        """`mesh` overrides the auto-built device mesh — pass
+        `parallel.multihost_client_mesh(K)` on pods (its `clients` axis
+        size must divide `cfg.n_clients`)."""
         self.cfg = cfg
         self.recorder = MetricsRecorder(verbose=verbose)
 
@@ -64,7 +70,14 @@ class Trainer:
                 cfg.dataset, cfg.data_root, synthetic_ok=cfg.synthetic_ok
             )
         self.fed = make_federated(source, cfg.n_clients, biased=cfg.biased_input)
-        self.mesh = largest_feasible_mesh(cfg.n_clients, cfg.max_devices)
+        self.mesh = mesh if mesh is not None else largest_feasible_mesh(
+            cfg.n_clients, cfg.max_devices
+        )
+        if cfg.n_clients % mesh_size(self.mesh) != 0:
+            raise ValueError(
+                f"n_clients={cfg.n_clients} not divisible by the mesh's "
+                f"clients axis ({mesh_size(self.mesh)})"
+            )
 
         model_cls = MODELS[cfg.model]
         fields = getattr(model_cls, "__dataclass_fields__", {})
@@ -199,6 +212,7 @@ class Trainer:
             reg_segments=reg_segments,
             lambda1=cfg.lambda1,
             lambda2=cfg.lambda2,
+            remat=cfg.remat,
         )
 
     def _fns(self, gid: int):

@@ -147,6 +147,34 @@ def test_resnet_smoke_with_batch_stats():
     assert np.isfinite(stats).all()
 
 
+def test_trainer_accepts_explicit_mesh():
+    from federated_pytorch_test_tpu.parallel import client_mesh
+
+    src4 = synthetic_cifar(n_train=320, n_test=60)
+    cfg = tiny("fedavg", model="net", nadmm=1, n_clients=4)
+    tr = Trainer(cfg, verbose=False, source=src4, mesh=client_mesh(2))
+    assert tr.mesh.devices.size == 2
+    tr.group_order = tr.group_order[:1]
+    tr.run()
+    assert np.asarray(tr.flat).shape[0] == 4
+
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(cfg, verbose=False, source=src4, mesh=client_mesh(3))
+
+
+def test_remat_matches_no_remat():
+    # jax.checkpoint must change memory, not math: identical training
+    # trajectory with and without
+    flats = {}
+    for remat in (False, True):
+        cfg = tiny("fedavg", model="net", nadmm=1, remat=remat)
+        tr = Trainer(cfg, verbose=False, source=SRC)
+        tr.group_order = tr.group_order[:1]
+        tr.run()
+        flats[remat] = np.asarray(tr.flat)
+    np.testing.assert_allclose(flats[False], flats[True], rtol=1e-5, atol=1e-6)
+
+
 def test_bfloat16_compute_trains():
     # mixed precision: convs/matmuls bf16, params + loss + L-BFGS f32
     cfg = tiny("fedavg", model="net", nadmm=2, compute_dtype="bfloat16")
