@@ -1,0 +1,225 @@
+"""Convergence parity: reference algorithm (torch) vs this framework (JAX),
+same data, same hyper-parameters, accuracy after every averaging round.
+
+The reference repo publishes no curves (BASELINE.md), and this environment
+has no CIFAR archive, so parity is established on the deterministic
+synthetic dataset both sides can load: 3 simple-CNN clients, disjoint
+shards, partial-parameter FedAvg (one layer group per round), stochastic
+L-BFGS inner solver. The torch side imports the reference's own
+`LBFGSNew` optimizer from /root/reference/src (imported, NOT copied) and
+re-drives its algorithm exactly as SURVEY.md §3.1 documents it: freeze all
+but one layer pair, fresh optimizer per group, average the active group
+across clients after each round (reference src/federated_trio.py:256-363).
+
+Writes benchmarks/convergence_parity.json:
+  {"reference": {"acc": [...]}, "framework": {"acc": [...]}, ...}
+
+Run: python benchmarks/convergence_parity.py   (~2-4 min, CPU)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+K = 3
+BATCH = 64
+NLOOP = 2  # outer loops over the 5 layer groups
+NADMM = 2  # averaging rounds per group
+N_TRAIN = 960  # per all clients; 320/client => 5 lockstep batches
+N_TEST = 300
+SEED = 0
+
+
+def synthetic():
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+
+    # noise high enough that the task is NOT saturated in one round —
+    # otherwise both sides hit ceiling and the curves say nothing
+    return synthetic_cifar(
+        n_train=N_TRAIN, n_test=N_TEST, seed=SEED, noise=150.0
+    )
+
+
+# --------------------------------------------------------------- torch side
+
+
+def run_reference(src) -> list:
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    sys.path.insert(0, "/root/reference/src")
+    from lbfgsnew import LBFGSNew  # reference optimizer (imported, not copied)
+
+    torch.manual_seed(SEED)
+
+    class Net(nn.Module):
+        # the reference's 5-layer simple CNN shape-for-shape
+        # (reference src/simple_models.py:9-39), ELU, NCHW
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 6, 5)
+            self.conv2 = nn.Conv2d(6, 16, 5)
+            self.fc1 = nn.Linear(400, 120)
+            self.fc2 = nn.Linear(120, 84)
+            self.fc3 = nn.Linear(84, 10)
+
+        def forward(self, x):
+            x = F.max_pool2d(F.elu(self.conv1(x)), 2)
+            x = F.max_pool2d(F.elu(self.conv2(x)), 2)
+            x = x.flatten(1)
+            x = F.elu(self.fc1(x))
+            x = F.elu(self.fc2(x))
+            return self.fc3(x)
+
+    mods = ["conv1", "conv2", "fc1", "fc2", "fc3"]
+    train_order = [2, 0, 1, 3, 4]  # reference src/simple_models.py:38-39
+
+    # identical common-seed init across clients (reference
+    # src/federated_trio.py:229-236)
+    nets = []
+    for _ in range(K):
+        torch.manual_seed(SEED)
+        nets.append(Net())
+
+    # disjoint contiguous shards, /255 normalization (no bias), NCHW
+    imgs = src.train_images.astype(np.float32) / 255.0
+    labs = src.train_labels.astype(np.int64)
+    per = len(imgs) // K
+    shards = [
+        (
+            torch.from_numpy(imgs[c * per : (c + 1) * per].transpose(0, 3, 1, 2)),
+            torch.from_numpy(labs[c * per : (c + 1) * per]),
+        )
+        for c in range(K)
+    ]
+    te_x = torch.from_numpy(
+        src.test_images.astype(np.float32).transpose(0, 3, 1, 2) / 255.0
+    )
+    te_y = torch.from_numpy(src.test_labels.astype(np.int64))
+
+    crit = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(SEED)
+
+    def accuracy():
+        accs = []
+        with torch.no_grad():
+            for net in nets:
+                pred = net(te_x).argmax(1)
+                accs.append(float((pred == te_y).float().mean()))
+        return accs
+
+    def unfreeze_only(net, gid):
+        want = mods[gid]
+        for name, mod in net.named_children():
+            for p in mod.parameters():
+                p.requires_grad = name == want
+        return list(getattr(net, want).parameters())
+
+    series = [accuracy()]
+    for nloop in range(NLOOP):
+        for gid in train_order:
+            opts = [
+                LBFGSNew(
+                    unfreeze_only(net, gid),
+                    history_size=10,
+                    max_iter=4,
+                    line_search_fn=True,
+                    batch_mode=True,
+                )
+                for net in nets
+            ]
+            for nadmm in range(NADMM):
+                # one epoch of lockstep minibatches per round
+                order = [rng.permutation(per) for _ in range(K)]
+                for s in range(per // BATCH):
+                    for c in range(K):
+                        x = shards[c][0][order[c][s * BATCH : (s + 1) * BATCH]]
+                        y = shards[c][1][order[c][s * BATCH : (s + 1) * BATCH]]
+
+                        def closure():
+                            if torch.is_grad_enabled():
+                                opts[c].zero_grad()
+                            loss = crit(nets[c](x), y)
+                            if loss.requires_grad:
+                                loss.backward()
+                            return loss
+
+                        opts[c].step(closure)
+                # FedAvg the ACTIVE group only (reference :353-363)
+                with torch.no_grad():
+                    mod_params = [
+                        list(getattr(net, mods[gid]).parameters()) for net in nets
+                    ]
+                    for pi in range(len(mod_params[0])):
+                        mean = sum(mp[pi] for mp in mod_params) / K
+                        for mp in mod_params:
+                            mp[pi].copy_(mean)
+                series.append(accuracy())
+    return series
+
+
+# ----------------------------------------------------------- framework side
+
+
+def run_framework(src) -> list:
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    cfg = get_preset(
+        "fedavg",
+        model="net",
+        batch=BATCH,
+        nloop=NLOOP,
+        nadmm=NADMM,
+        biased_input=False,
+        reg_mode="none",
+        check_results=True,
+        seed=SEED,
+        eval_batch=N_TEST,
+    )
+    tr = Trainer(cfg, verbose=False, source=src)
+    series = [list(np.asarray(tr.evaluate(), float))]
+    rec = tr.run()
+    series += [r["value"] for r in rec.series["test_accuracy"]]
+    return series
+
+
+def main():
+    src = synthetic()
+    t0 = time.time()
+    fw = run_framework(src)
+    t_fw = time.time() - t0
+    t0 = time.time()
+    ref = run_reference(src)
+    t_ref = time.time() - t0
+
+    out = {
+        "workload": (
+            f"{K}-client simple-CNN partial-param FedAvg on deterministic "
+            f"synthetic CIFAR ({N_TRAIN} train / {N_TEST} test), batch "
+            f"{BATCH}, nloop={NLOOP}, nadmm={NADMM}, L-BFGS(10,4,ls,batch)"
+        ),
+        "reference": {"acc": ref, "seconds": round(t_ref, 1)},
+        "framework": {"acc": fw, "seconds": round(t_fw, 1)},
+        "final_mean_acc": {
+            "reference": round(float(np.mean(ref[-1])), 4),
+            "framework": round(float(np.mean(fw[-1])), 4),
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "convergence_parity.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["final_mean_acc"]))
+
+
+if __name__ == "__main__":
+    main()

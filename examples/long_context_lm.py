@@ -11,9 +11,12 @@ equals the dense one. On a CPU dev box run with a virtual ring:
 On a TPU slice just run it — the ring rides the ICI.
 """
 
-import functools
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -68,9 +71,18 @@ def main():
         out_specs=P(),
         check_vma=False,
     )
-    loss_fn = functools.partial(
-        lambda f, tok, tgt: sharded(f, tok, tgt), tok=tokens, tgt=targets
-    )
+    loss_fn = lambda f: sharded(f, tokens, targets)  # noqa: E731
+
+    # the sharded ring loss must equal the dense unsharded loss exactly
+    def dense_loss(f):
+        logits = lm_dense.apply({"params": unravel(f)}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets
+        ).mean()
+
+    ring_l, dense_l = float(loss_fn(flat)), float(dense_loss(flat))
+    assert abs(ring_l - dense_l) < 1e-3 * max(1.0, abs(dense_l)), (ring_l, dense_l)
+    print(f"ring == dense loss check: {ring_l:.6f} vs {dense_l:.6f}")
 
     cfg = LBFGSConfig(max_iter=4, history_size=10, line_search=True,
                       batch_mode=True)

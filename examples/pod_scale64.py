@@ -9,6 +9,11 @@ Single-host (or the dev box) it degrades gracefully: the mesh shrinks to
 the local devices and the same code runs.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from federated_pytorch_test_tpu.parallel import (
     initialize_distributed,
     multihost_client_mesh,

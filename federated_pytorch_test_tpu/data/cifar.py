@@ -136,6 +136,7 @@ def synthetic_cifar(
     n_test: int = 10_000,
     num_classes: int = 10,
     seed: int = 0,
+    noise: float = 35.0,
 ) -> DataSource:
     """Deterministic learnable stand-in with CIFAR shapes.
 
@@ -151,8 +152,8 @@ def synthetic_cifar(
 
     def draw(n: int, r: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
         labels = r.integers(0, num_classes, size=n).astype(np.int32)
-        noise = r.normal(0.0, 35.0, size=(n, 32, 32, 3))
-        images = np.clip(proto[labels] + noise, 0, 255).astype(np.uint8)
+        eps = r.normal(0.0, noise, size=(n, 32, 32, 3))
+        images = np.clip(proto[labels] + eps, 0, 255).astype(np.uint8)
         return images, labels
 
     tr_i, tr_l = draw(n_train, rng)
