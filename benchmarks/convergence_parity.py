@@ -51,12 +51,20 @@ def synthetic():
 # --------------------------------------------------------------- torch side
 
 
+REFERENCE_SRC = os.environ.get("REFERENCE_SRC", "/root/reference/src")
+if not os.path.isdir(REFERENCE_SRC):  # fail fast, before any training runs
+    sys.exit(
+        f"reference checkout not found at {REFERENCE_SRC} "
+        "(set REFERENCE_SRC to its src/ directory)"
+    )
+
+
 def run_reference(src) -> list:
     import torch
     import torch.nn as nn
     import torch.nn.functional as F
 
-    sys.path.insert(0, "/root/reference/src")
+    sys.path.insert(0, REFERENCE_SRC)
     from lbfgsnew import LBFGSNew  # reference optimizer (imported, not copied)
 
     torch.manual_seed(SEED)
@@ -90,8 +98,15 @@ def run_reference(src) -> list:
         torch.manual_seed(SEED)
         nets.append(Net())
 
-    # disjoint contiguous shards, /255 normalization (no bias), NCHW
-    imgs = src.train_images.astype(np.float32) / 255.0
+    # disjoint contiguous shards; the reference's unbiased normalization
+    # Normalize((.5,.5,.5),(.5,.5,.5)) after ToTensor, i.e.
+    # (x/255 - 0.5)/0.5 (reference src/no_consensus_trio.py:34-38) —
+    # identical to the framework side's UNBIASED stat, so both curves see
+    # the SAME input scaling; NCHW for torch
+    def norm(a):
+        return (a.astype(np.float32) / 255.0 - 0.5) / 0.5
+
+    imgs = norm(src.train_images)
     labs = src.train_labels.astype(np.int64)
     per = len(imgs) // K
     shards = [
@@ -101,9 +116,7 @@ def run_reference(src) -> list:
         )
         for c in range(K)
     ]
-    te_x = torch.from_numpy(
-        src.test_images.astype(np.float32).transpose(0, 3, 1, 2) / 255.0
-    )
+    te_x = torch.from_numpy(norm(src.test_images).transpose(0, 3, 1, 2))
     te_y = torch.from_numpy(src.test_labels.astype(np.int64))
 
     crit = nn.CrossEntropyLoss()

@@ -28,6 +28,7 @@
 #include <queue>
 #include <random>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -169,6 +170,22 @@ struct Batcher {
   }
 };
 
+// Live-handle registry: batcher_next pins a handle under the registry
+// lock, so a next() racing with destroy either pins before the drain
+// (and is drained) or finds the handle already unregistered and returns
+// -1 — a stale handle can never touch freed memory. Handles are
+// monotonically increasing ids (NOT pointers), so a freed handle value is
+// never reissued and the ABA hazard of address reuse cannot arise.
+// Lock order: g_registry_mu, then Batcher::mu.
+static std::mutex g_registry_mu;
+static std::unordered_map<uint64_t, Batcher*> g_registry;
+static uint64_t g_next_handle = 1;
+
+static Batcher* registry_find(void* handle) {
+  auto it = g_registry.find(reinterpret_cast<uint64_t>(handle));
+  return it == g_registry.end() ? nullptr : it->second;
+}
+
 void* batcher_create(const uint8_t* images, const int32_t* labels, int64_t n,
                      int64_t batch, uint64_t seed, int drop_last,
                      int64_t prefetch_depth) {
@@ -183,18 +200,30 @@ void* batcher_create(const uint8_t* images, const int32_t* labels, int64_t n,
   b->epoch = 0;
   b->capacity = static_cast<size_t>(prefetch_depth > 0 ? prefetch_depth : 2);
   b->producer = std::thread([b] { b->run(); });
-  return b;
+  uint64_t id;
+  {
+    std::lock_guard<std::mutex> reg(g_registry_mu);
+    id = g_next_handle++;
+    g_registry.emplace(id, b);
+  }
+  return reinterpret_cast<void*>(id);
 }
 
 // Blocks until a batch is staged; copies it into the caller's buffers.
 // Returns the sample count (<= batch; < batch only for a non-dropped
-// tail), or -1 once the batcher is being destroyed.
+// tail), or -1 once the batcher is destroyed (or being destroyed).
 int64_t batcher_next(void* handle, uint8_t* out_images, int32_t* out_labels) {
-  auto* b = static_cast<Batcher*>(handle);
+  Batcher* b;
   Batcher::Slot s;
   {
-    std::unique_lock<std::mutex> lk(b->mu);
-    ++b->active_consumers;
+    std::unique_lock<std::mutex> lk;
+    {
+      std::lock_guard<std::mutex> reg(g_registry_mu);
+      b = registry_find(handle);
+      if (!b) return -1;  // destroyed (ids are never reissued)
+      lk = std::unique_lock<std::mutex>(b->mu);
+      ++b->active_consumers;  // pinned: destroy now waits for us
+    }
     b->cv_ready.wait(lk, [&] { return !b->ready.empty() || b->stop.load(); });
     if (b->stop.load() && b->ready.empty()) {
       // destroy() is waiting on cv_idle for us to leave before freeing b
@@ -213,11 +242,19 @@ int64_t batcher_next(void* handle, uint8_t* out_images, int32_t* out_labels) {
   return s.count;
 }
 
-// Safe against consumers concurrently blocked in batcher_next (e.g. a
-// GC-triggered close from another Python thread while the GIL is released
-// inside the ctypes call): they are woken and drained before the free.
+// Safe against consumers concurrently inside OR entering batcher_next
+// (e.g. a GC-triggered close from another Python thread while the GIL is
+// released in the ctypes call): the handle is unregistered first, so new
+// calls bounce, and pinned consumers are woken and drained before the
+// free. Idempotent: a second destroy on the same handle is a no-op.
 void batcher_destroy(void* handle) {
-  auto* b = static_cast<Batcher*>(handle);
+  Batcher* b;
+  {
+    std::lock_guard<std::mutex> reg(g_registry_mu);
+    b = registry_find(handle);
+    if (!b) return;  // already destroyed
+    g_registry.erase(reinterpret_cast<uint64_t>(handle));
+  }
   b->stop.store(true);
   {
     std::unique_lock<std::mutex> lk(b->mu);
