@@ -37,9 +37,10 @@ def _add_config_flags(parser: argparse.ArgumentParser) -> None:
                 default=None,
             )
         else:
-            typ = {"int": int, "float": float}.get(str(f.type), str)
-            if "int | None" in str(f.type) or "str | None" in str(f.type):
-                typ = str
+            ts = str(f.type)
+            typ = {"int": int, "float": float}.get(ts, str)
+            if "int | None" in ts:
+                typ = int  # flag absent => None; given => parsed as int
             parser.add_argument(flag, dest=f.name, type=typ, default=None)
 
 
@@ -75,9 +76,6 @@ def main(argv=None) -> int:
         for f in dataclasses.fields(ExperimentConfig)
         if getattr(args, f.name) is not None
     }
-    for key in ("max_devices",):
-        if key in overrides:
-            overrides[key] = int(overrides[key])
     cfg = get_preset(args.preset, **overrides)
     print(f"# running preset={args.preset} cfg={cfg}")
     recorder = run_experiment(cfg, verbose=not args.quiet)

@@ -162,12 +162,17 @@ def synthetic_cifar(
 
 
 def load_cifar(
-    name: str = "cifar10", root: str | None = None, synthetic_ok: bool = True
+    name: str = "cifar10",
+    root: str | None = None,
+    synthetic_ok: bool = True,
+    synthetic_n_train: int | None = None,
+    synthetic_n_test: int | None = None,
 ) -> DataSource:
     """Load `name` from `root` (or $CIFAR_DATA_DIR), falling back to the
     synthetic source only when NO archive is present at all. A present but
     corrupt/partial archive raises — it must not silently train on
-    synthetic data."""
+    synthetic data. The `synthetic_*` sizes apply only to the fallback
+    (smoke tests / CI shrink it; a real archive is never truncated)."""
     root = root or os.environ.get("CIFAR_DATA_DIR", "./torchdata")
     loader = {"cifar10": load_cifar10, "cifar100": load_cifar100}[name]
     try:
@@ -180,4 +185,14 @@ def load_cifar(
             "synthetic stand-in dataset",
             stacklevel=2,
         )
-        return synthetic_cifar(num_classes=10 if name == "cifar10" else 100)
+        sizes = {
+            k: v
+            for k, v in (
+                ("n_train", synthetic_n_train),
+                ("n_test", synthetic_n_test),
+            )
+            if v is not None  # else synthetic_cifar's own defaults apply
+        }
+        return synthetic_cifar(
+            num_classes=10 if name == "cifar10" else 100, **sizes
+        )

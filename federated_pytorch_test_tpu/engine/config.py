@@ -42,6 +42,10 @@ class ExperimentConfig:
     dataset: str = "cifar10"  # cifar10 | cifar100
     data_root: str | None = None  # None => $CIFAR_DATA_DIR or ./torchdata
     synthetic_ok: bool = True  # fall back to synthetic data if no archive
+    # shrink the SYNTHETIC fallback only (smoke runs / CI); a real archive
+    # is never truncated
+    synthetic_n_train: int | None = None
+    synthetic_n_test: int | None = None
 
     n_clients: int = 3
     batch: int = 512  # reference `default_batch`

@@ -67,7 +67,11 @@ class Trainer:
 
         if source is None:
             source = load_cifar(
-                cfg.dataset, cfg.data_root, synthetic_ok=cfg.synthetic_ok
+                cfg.dataset,
+                cfg.data_root,
+                synthetic_ok=cfg.synthetic_ok,
+                synthetic_n_train=cfg.synthetic_n_train,
+                synthetic_n_test=cfg.synthetic_n_test,
             )
         self.fed = make_federated(source, cfg.n_clients, biased=cfg.biased_input)
         self.mesh = mesh if mesh is not None else largest_feasible_mesh(

@@ -5,10 +5,15 @@ The reference saves per-client torch files `./s1.model`... holding
 (reference src/federated_trio.py:372-390) but on resume restores only the
 model weights — optimizer state is written yet never loaded, and the ADMM
 y/z/rho state is not checkpointed at all (reference
-src/federated_trio.py:103-112; SURVEY.md §5). Here the whole algorithm
-state tree — stacked client params, BatchNorm statistics, consensus
-(y, z, rho), and the loop cursor — is one orbax checkpoint, so a resumed
-run continues the exact round it stopped in.
+src/federated_trio.py:103-112; SURVEY.md §5). Here one orbax checkpoint
+holds the whole algorithm state tree AT AN OUTER-LOOP BOUNDARY: stacked
+client params, BatchNorm statistics, and the loop cursor. That IS the
+complete state there — L-BFGS history and consensus y/z/rho are
+re-initialized fresh at every partition round by construction (the
+reference builds a fresh optimizer and zeroed duals per round,
+src/federated_trio.py:273-275, src/consensus_admm_trio.py:281-288), and
+epoch shuffles are a pure function of (seed, loop indices), so a resumed
+run replays the exact trajectory it would have taken.
 """
 
 from __future__ import annotations

@@ -1,0 +1,55 @@
+"""CLI surface tests: the `python -m federated_pytorch_test_tpu` driver."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+)
+
+
+def _run(*args, timeout=600):
+    return subprocess.run(
+        [sys.executable, "-m", "federated_pytorch_test_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=ENV,
+    )
+
+
+def test_list_presets():
+    r = _run("--list-presets", timeout=120)
+    assert r.returncode == 0, r.stderr
+    for name in ("fedavg", "admm_resnet", "fedavg_scale64"):
+        assert name in r.stdout
+
+
+def test_unknown_preset_rejected():
+    r = _run("--preset", "nope", timeout=120)
+    assert r.returncode != 0
+    assert "invalid choice" in r.stderr
+
+
+def test_tiny_training_run_with_metrics_out(tmp_path):
+    out = tmp_path / "metrics.json"
+    r = _run(
+        "--preset", "fedavg",
+        "--model", "net",
+        "--batch", "40",
+        "--nloop", "1",
+        "--nepoch", "1",
+        "--nadmm", "1",
+        "--n-clients", "4",
+        "--synthetic-n-train", "480",
+        "--synthetic-n-test", "64",
+        "--no-check-results",
+        "--quiet",
+        "--metrics-out", str(out),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    series = json.loads(out.read_text())
+    assert "train_loss" in series and "dual_residual" in series
+    assert len(series["train_loss"][-1]["value"]) == 4  # per-client losses

@@ -57,6 +57,22 @@ def test_fedavg_round_matches_reference(mesh):
     np.testing.assert_allclose(dual, np.linalg.norm(x.mean(0)) / N, rtol=1e-6)
 
 
+def test_fedavg_equal_clients_is_noop(mesh):
+    # property (SURVEY.md §4b): K identical clients -> the average equals
+    # every client's x, so broadcasting z back changes nothing
+    rng = np.random.default_rng(1)
+    x1 = rng.normal(size=N).astype(np.float32)
+    x = np.broadcast_to(x1, (K, N)).copy()
+
+    def body(xl):
+        st = fedavg_init(N)
+        st, _ = fedavg_round(xl, st)
+        return st.z
+
+    z = _spmd(mesh, body, jnp.asarray(x), out_specs=P())
+    np.testing.assert_allclose(np.asarray(z), x1, rtol=1e-6)
+
+
 def test_admm_penalty_formula():
     rng = np.random.default_rng(1)
     x, y, z = (rng.normal(size=N).astype(np.float32) for _ in range(3))

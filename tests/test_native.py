@@ -193,6 +193,9 @@ def test_tsan_stress_harness():
         [os.path.join(native_dir, "build", "stress_tsan")],
         capture_output=True, text=True, timeout=300,
     )
+    if "FATAL: ThreadSanitizer" in r.stderr and "data race" not in r.stderr:
+        # TSAN runtime can't initialize on this kernel (e.g. mmap_rnd_bits)
+        pytest.skip(f"TSAN runtime unavailable: {r.stderr[:160]}")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "stress OK" in r.stdout
 
