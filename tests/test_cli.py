@@ -35,9 +35,14 @@ def test_unknown_preset_rejected():
 
 def test_tiny_training_run_with_metrics_out(tmp_path):
     out = tmp_path / "metrics.json"
+    empty = tmp_path / "no-archive"
+    empty.mkdir()
     r = _run(
         "--preset", "fedavg",
         "--model", "net",
+        # deterministic synthetic fallback: an empty data root, so a real
+        # archive on this machine can't silently replace the tiny dataset
+        "--data-root", str(empty),
         "--batch", "40",
         "--nloop", "1",
         "--nepoch", "1",

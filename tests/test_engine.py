@@ -147,6 +147,52 @@ def test_resnet_smoke_with_batch_stats():
     assert np.isfinite(stats).all()
 
 
+def _group_norm(tr, gid):
+    flat = np.asarray(tr.flat)
+    segs = tr.model_partition.groups[gid]
+    v = np.concatenate(
+        [flat[:, s.start : s.start + s.size] for s in segs], axis=1
+    )
+    return float(np.linalg.norm(v))
+
+
+@pytest.mark.parametrize("mode,preset", [
+    ("first_linear", "no_consensus"),  # the fc1 or-quirk
+    ("active_linear", "fedavg"),       # reference src/federated_trio.py:309
+])
+def test_regularization_modes_bite(mode, preset):
+    # a large elastic net must shrink the regularized group relative to an
+    # unregularized run — proving the penalty reaches the right segments
+    norms = {}
+    for lam in (0.0, 0.5):
+        cfg = tiny(
+            preset, model="net", nadmm=1, reg_mode=mode,
+            lambda1=lam, lambda2=lam,
+        )
+        tr = Trainer(cfg, verbose=False, source=SRC)
+        gid = tr.model_partition.linear_group_ids[0]  # fc1
+        if preset != "no_consensus":  # 'none' trains the whole vector
+            tr.group_order = [gid]
+        tr.run()
+        norms[lam] = _group_norm(tr, gid)
+    assert norms[0.5] < 0.9 * norms[0.0], norms
+
+
+def test_average_model_one_shot_mean():
+    # reference src/no_consensus_trio.py:22,134-160: independently-drawn
+    # clients optionally replaced by their whole-model mean at startup
+    cfg = tiny("no_consensus", model="net", init_model=False, average_model=True)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    flat = np.asarray(tr.flat)
+    assert np.abs(flat - flat[:1]).max() == 0.0  # all clients identical
+
+    # without the flag, independent draws differ
+    cfg = tiny("no_consensus", model="net", init_model=False)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    flat = np.asarray(tr.flat)
+    assert np.abs(flat - flat[:1]).max() > 0.0
+
+
 def test_trainer_accepts_explicit_mesh():
     from federated_pytorch_test_tpu.parallel import client_mesh
 
