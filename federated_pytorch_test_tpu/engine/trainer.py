@@ -144,6 +144,12 @@ class Trainer:
         self._health_fn = None
         self._completed_nloops = 0
         self._step_num = 0
+        # per-(group, client) ADMM penalty, PERSISTENT across outer loops:
+        # the reference allocates rho=[L,K]*rho0 once outside both loops
+        # (reference src/consensus_admm_trio.py:263), so BB adaptations for
+        # a layer carry over to its next visit; y/z/yhat are re-zeroed per
+        # round (reference :281-302) and are not stored
+        self._rho_store: Dict[int, Any] = {}
 
         if cfg.load_model:
             self._restore()
@@ -299,6 +305,8 @@ class Trainer:
         check = cfg.fault_mode != "off"
         epoch_fn, consensus_fn, init_fn = self._fns(gid)
         lstate, y, z, rho, extra = init_fn(self.flat)
+        if cfg.strategy == "admm" and gid in self._rho_store:
+            rho = self._rho_store[gid]  # carry BB-adapted rho across loops
         gsize = self.partition.group_size(gid)
 
         for nadmm in range(cfg.nadmm):
@@ -382,6 +390,8 @@ class Trainer:
                 self.recorder.accuracies(
                     self.evaluate(), nloop=nloop, group=gid, nadmm=nadmm
                 )
+        if cfg.strategy == "admm":
+            self._rho_store[gid] = rho
 
     def run(self) -> MetricsRecorder:
         """The full experiment (all Nloop outer loops).
@@ -415,6 +425,10 @@ class Trainer:
             "flat": self.flat,
             "batch_stats": self.stats,
             "completed_nloops": np.int64(self._completed_nloops),
+            # rho is the ONE piece of consensus state that outlives a
+            # round (see _rho_store); keyed by group id as strings for
+            # the checkpoint tree
+            "rho_store": {str(g): r for g, r in self._rho_store.items()},
         }
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
@@ -426,6 +440,8 @@ class Trainer:
             lambda x: jax.device_put(jnp.asarray(x), csh), state["batch_stats"]
         )
         self._completed_nloops = int(state["completed_nloops"])
+        for g, r in state.get("rho_store", {}).items():
+            self._rho_store[int(g)] = jax.device_put(jnp.asarray(r), csh)
 
 
 def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> MetricsRecorder:

@@ -7,13 +7,17 @@ model weights — optimizer state is written yet never loaded, and the ADMM
 y/z/rho state is not checkpointed at all (reference
 src/federated_trio.py:103-112; SURVEY.md §5). Here one orbax checkpoint
 holds the whole algorithm state tree AT AN OUTER-LOOP BOUNDARY: stacked
-client params, BatchNorm statistics, and the loop cursor. That IS the
-complete state there — L-BFGS history and consensus y/z/rho are
-re-initialized fresh at every partition round by construction (the
-reference builds a fresh optimizer and zeroed duals per round,
-src/federated_trio.py:273-275, src/consensus_admm_trio.py:281-288), and
-epoch shuffles are a pure function of (seed, loop indices), so a resumed
-run replays the exact trajectory it would have taken.
+client params, BatchNorm statistics, the loop cursor, and the
+per-(group, client) ADMM rho store. That IS the complete state there —
+L-BFGS history and the consensus y/z duals are re-initialized fresh at
+every partition round by construction (the reference builds a fresh
+optimizer and zeroed duals per round, src/federated_trio.py:273-275,
+src/consensus_admm_trio.py:281-288), rho is the ONE consensus quantity
+that outlives a round (allocated once outside the reference's loops,
+src/consensus_admm_trio.py:263, hence `Trainer._rho_store` and its slot
+in the checkpoint), and epoch shuffles are a pure function of
+(seed, loop indices) — so a resumed run replays the exact trajectory it
+would have taken.
 """
 
 from __future__ import annotations

@@ -178,6 +178,27 @@ def test_regularization_modes_bite(mode, preset):
     assert norms[0.5] < 0.9 * norms[0.0], norms
 
 
+def test_admm_rho_persists_across_rounds():
+    # the reference allocates rho once OUTSIDE its loops, so BB-adapted
+    # values for a layer carry to that layer's next visit
+    # (reference src/consensus_admm_trio.py:263); y/z are re-zeroed
+    import jax.numpy as jnp
+
+    cfg = tiny("admm", model="net", nadmm=1, bb_update=True)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    gid = tr.group_order[0]
+
+    # seed the store with a custom rho: the next round must USE it...
+    _, _, _, rho0, _ = tr._fns(gid)[2](tr.flat)
+    custom = jnp.full_like(rho0, 0.0567)
+    tr._rho_store[gid] = custom
+    tr.run_round(nloop=0, gid=gid)
+    assert np.isclose(tr.recorder.latest("mean_rho"), 0.0567, rtol=1e-5)
+    # ...and persist whatever rho the round ended with
+    assert gid in tr._rho_store
+    assert np.asarray(tr._rho_store[gid]).shape == np.asarray(rho0).shape
+
+
 def test_average_model_one_shot_mean():
     # reference src/no_consensus_trio.py:22,134-160: independently-drawn
     # clients optionally replaced by their whole-model mean at startup
