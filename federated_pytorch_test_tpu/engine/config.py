@@ -186,13 +186,16 @@ PRESETS = {
     # reference src/federated_trio.py: Net, batch 512, Nloop=12, Nadmm=3.
     "fedavg": ExperimentConfig(name="fedavg", model="net", strategy="fedavg"),
     # reference src/federated_trio_resnet.py: ResNet18, batch 32, Nadmm=3,
-    # no regularization, shuffled block order.
+    # no regularization, shuffled block order, and a SINGLE unbiased
+    # normalization for all clients (one transform, :27-29 — the resnet
+    # drivers have no biased_input machinery).
     "fedavg_resnet": ExperimentConfig(
         name="fedavg_resnet",
         model="resnet18",
         batch=32,
         strategy="fedavg",
         reg_mode="none",
+        biased_input=False,
         shuffle_group_order=True,
     ),
     # reference src/consensus_admm_trio.py: Net, batch 512, Nadmm=5,
@@ -213,6 +216,7 @@ PRESETS = {
         strategy="admm",
         nadmm=3,
         reg_mode="none",
+        biased_input=False,
         bb_update=False,
         shuffle_group_order=True,
     ),
@@ -228,6 +232,7 @@ PRESETS = {
         batch=32,
         strategy="fedavg",
         reg_mode="none",
+        biased_input=False,
         shuffle_group_order=True,
         check_results=False,
     ),
@@ -240,6 +245,7 @@ PRESETS = {
         strategy="admm",
         nadmm=3,
         reg_mode="none",
+        biased_input=False,
         bb_update=False,
         shuffle_group_order=True,
         check_results=False,

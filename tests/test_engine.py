@@ -45,6 +45,11 @@ def test_presets_cover_reference_drivers():
         assert PRESETS[name].n_clients == 64
         assert PRESETS[name].dataset == "cifar100"
         assert PRESETS[name].model == "resnet18"
+    # the resnet drivers use ONE unbiased transform for all clients
+    # (reference src/federated_trio_resnet.py:27-29); the simple drivers
+    # bias per client (reference src/federated_trio.py:34)
+    for name, cfg in PRESETS.items():
+        assert cfg.biased_input == (cfg.model != "resnet18"), name
 
 
 def test_fedavg_round_trains_and_syncs():
@@ -112,9 +117,10 @@ def test_eval_returns_per_client_accuracy():
     assert all(0.0 <= a <= 1.0 for a in accs)
 
 
-def test_checkpoint_roundtrip(tmp_path):
+@pytest.mark.parametrize("preset", ["fedavg", "admm"])
+def test_checkpoint_roundtrip(tmp_path, preset):
     cfg = tiny(
-        "fedavg",
+        preset,
         model="net",
         nadmm=1,
         save_model=True,
@@ -130,6 +136,15 @@ def test_checkpoint_roundtrip(tmp_path):
         np.asarray(tr2.flat), np.asarray(tr.flat), rtol=1e-6
     )
     assert tr2._completed_nloops == 1
+    # the persistent ADMM rho store survives the round trip (str/int key
+    # conversion, device_put) so BB-adapted resume replays exactly
+    assert sorted(tr2._rho_store) == sorted(tr._rho_store)
+    for g in tr._rho_store:
+        np.testing.assert_allclose(
+            np.asarray(tr2._rho_store[g]), np.asarray(tr._rho_store[g])
+        )
+    if preset == "admm":
+        assert tr._rho_store  # non-empty: the write-back path was covered
 
 
 def test_resnet_smoke_with_batch_stats():
@@ -188,14 +203,17 @@ def test_admm_rho_persists_across_rounds():
     tr = Trainer(cfg, verbose=False, source=SRC)
     gid = tr.group_order[0]
 
-    # seed the store with a custom rho: the next round must USE it...
+    # a round on an EMPTY store must write the group's rho back
+    assert not tr._rho_store
+    tr.run_round(nloop=0, gid=gid)
+    assert gid in tr._rho_store
+
+    # a seeded store must be USED by the next visit of that group
     _, _, _, rho0, _ = tr._fns(gid)[2](tr.flat)
     custom = jnp.full_like(rho0, 0.0567)
     tr._rho_store[gid] = custom
-    tr.run_round(nloop=0, gid=gid)
+    tr.run_round(nloop=1, gid=gid)
     assert np.isclose(tr.recorder.latest("mean_rho"), 0.0567, rtol=1e-5)
-    # ...and persist whatever rho the round ended with
-    assert gid in tr._rho_store
     assert np.asarray(tr._rho_store[gid]).shape == np.asarray(rho0).shape
 
 
