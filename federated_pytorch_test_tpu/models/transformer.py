@@ -9,10 +9,12 @@ the head, mirroring how the reference groups ResNet18's 62 tensors into 10
 blocks (reference src/federated_trio_resnet.py:174-178).
 
 Attention is pluggable: `attn_impl='dense'` runs the single-device
-reference path; `attn_impl='ring'` runs ring attention over the `seq` mesh
-axis (parallel/ring.py) for sequences sharded across devices — the model
-code is identical either way, which is the point: sequence parallelism is
-a property of the call context (mesh + shard_map), not of the model.
+reference path; `attn_impl='flash'` runs the Pallas blockwise kernels
+(ops/flash_attention.py — no [S, S] scores in HBM, the single-device
+long-context path); `attn_impl='ring'` runs ring attention over the `seq`
+mesh axis (parallel/ring.py) for sequences sharded across devices. The
+model code is identical in every case, which is the point: how attention
+executes is a property of the call site, not a fork of the model.
 """
 
 from __future__ import annotations
@@ -39,13 +41,18 @@ class MultiHeadAttention(nn.Module):
 
     dim: int
     num_heads: int
-    attn_impl: str = "dense"  # 'dense' | 'ring'
+    attn_impl: str = "dense"  # 'dense' | 'ring' | 'flash'
     causal: bool = False
     seq_axis: str = SEQ_AXIS
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.attn_impl not in ("dense", "ring", "flash"):
+            raise ValueError(
+                f"attn_impl must be 'dense', 'ring' or 'flash', "
+                f"got {self.attn_impl!r}"
+            )
         b, s, _ = x.shape
         h, hd = self.num_heads, self.dim // self.num_heads
         qkv = nn.Dense(
@@ -60,6 +67,14 @@ class MultiHeadAttention(nn.Module):
         )
         if self.attn_impl == "ring":
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        elif self.attn_impl == "flash":
+            # Pallas blockwise kernels (ops/flash_attention.py): no [S, S]
+            # scores in HBM — the long-context single-device path
+            from federated_pytorch_test_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v, causal=self.causal)
         else:
             out = dense_attention(q, k, v, causal=self.causal)
         out = out.reshape(b, s, self.dim)

@@ -14,8 +14,10 @@ from federated_pytorch_test_tpu.ops.compact_pallas import (
     compact_direction_pallas,
     fused_gram_projections,
 )
+from federated_pytorch_test_tpu.ops.flash_attention import flash_attention
 
 __all__ = [
     "compact_direction_pallas",
+    "flash_attention",
     "fused_gram_projections",
 ]

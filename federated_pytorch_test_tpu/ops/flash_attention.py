@@ -1,0 +1,297 @@
+"""Flash attention as Pallas TPU kernels (forward + flash-2 backward).
+
+`parallel.dense_attention` materializes the `[B, H, S, S]` score matrix —
+fine at ViT's 64 tokens, hostile at long context: HBM traffic and memory
+grow with S². These kernels compute exact attention blockwise in VMEM
+(online softmax, never more than a `[BQ, BK]` tile of scores live), with
+the standard flash-2 backward from the saved per-row logsumexp:
+
+    fwd:  for each Q block, stream KV blocks; carry (m, l, o); save
+          L = m + log(l) per row.
+    bwd:  D = rowsum(dO * O); then
+          dV_j = sum_i P_ij^T dO_i,   dP_ij = dO_i V_j^T,
+          dS_ij = P_ij (dP_ij - D_i),
+          dQ_i = sum_j dS_ij K_j * scale,  dK_j = sum_i dS_ij^T Q_i * scale
+          with P recomputed blockwise from (Q, K, L).
+
+Layout: kernels take `[S, D]` per (batch, head) and the grid's leading
+axis sweeps B*H — Q/K/V arrive as `[BH, S, D]`. The public entry
+`flash_attention(q, k, v)` keeps the framework's `[B, S, H, D]`
+convention of `parallel/ring.py` and is a drop-in for `dense_attention`
+(same signature semantics, exact same math — tests/test_flash.py).
+Composable with sequence parallelism: inside a `seq`-axis shard_map each
+device can run this kernel on its resident block while `ring_attention`
+handles the cross-device streaming.
+
+Off-TPU the kernels run in Pallas interpret mode, so CPU tests exercise
+the exact code path the TPU compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30
+
+# Q/KV tile heights. 128 matches the MXU systolic edge; S must be a
+# multiple (the LM/ViT sequence lengths are powers of two — assert, don't
+# silently pad, so callers see the constraint).
+_BQ = 128
+_BK = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, s: int, causal: bool,
+                scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0] * scale  # [BQ, D]
+    d = q.shape[-1]
+    nkv = s // _BK
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(j * _BK, _BK), :]  # [BK, D]
+        v = v_ref[0, pl.ds(j * _BK, _BK), :]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [BQ, BK]
+        if causal:
+            qpos = qi * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
+            kpos = j * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+            sc = jnp.where(kpos <= qpos, sc, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=1))
+        p = jnp.exp(sc - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=1)
+        o = o * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return o, m_new, l
+
+    o0 = jnp.zeros((_BQ, d), jnp.float32)
+    m0 = jnp.full((_BQ,), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((_BQ,), jnp.float32)
+    # causal: KV blocks past this Q block are fully masked — skip them
+    upper = (qi + 1) * _BQ // _BK if causal else nkv
+    o, m, l = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
+
+    o_ref[0] = o / l[:, None]
+    lse_ref[0] = (m + jnp.log(l))[:, None]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, s: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D] (unscaled)
+    do = do_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
+    d = q.shape[-1]
+    nkv = s // _BK
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * _BK, _BK), :]
+        v = v_ref[0, pl.ds(j * _BK, _BK), :]
+        sc = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if causal:
+            qpos = qi * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
+            kpos = j * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+            sc = jnp.where(kpos <= qpos, sc, _NEG_BIG)
+        p = jnp.exp(sc - lse[:, None])  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    upper = (qi + 1) * _BQ // _BK if causal else nkv
+    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((_BQ, d), jnp.float32))
+    dq_ref[0] = dq * scale
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, s: int, causal: bool, scale: float):
+    ki = pl.program_id(1)
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+    d = k.shape[-1]
+    nq = s // _BQ
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * _BQ, _BQ), :]
+        do = do_ref[0, pl.ds(i * _BQ, _BQ), :]
+        lse = lse_ref[0, pl.ds(i * _BQ, _BQ), :][:, 0]
+        delta = delta_ref[0, pl.ds(i * _BQ, _BQ), :][:, 0]
+        sc = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [BQ, BK]
+        if causal:
+            qpos = i * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
+            kpos = ki * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+            sc = jnp.where(kpos <= qpos, sc, _NEG_BIG)
+        p = jnp.exp(sc - lse[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return dk, dv
+
+    # causal: Q blocks before this KV block see none of it — skip them
+    lower = ki * _BK // _BQ if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lower, nq, body,
+        (jnp.zeros((_BK, d), jnp.float32), jnp.zeros((_BK, d), jnp.float32)),
+    )
+    dk_ref[0] = dk * scale
+    dv_ref[0] = dv
+
+
+# The kernels keep each (batch, head)'s full K/V (forward, dq) or Q/dO
+# (dk/dv) resident in VMEM and stream tiles out of them with pl.ds — so
+# S·D per operand is VMEM-bounded. ~8 MB for the two resident operands
+# leaves room for tiles/accumulators in a ~16 MB VMEM: S ≤ 16384 at
+# D=64. Past that, the KV/Q stream must move to a grid dimension with
+# scratch-carried accumulators (future work); the guard makes the
+# ceiling loud instead of letting Mosaic fail obscurely.
+_VMEM_OPERAND_BUDGET = 8 * 1024 * 1024
+
+
+def _check_shapes(s: int, d: int):
+    if s % _BQ != 0 or s % _BK != 0:
+        raise ValueError(
+            f"flash attention needs S divisible by {max(_BQ, _BK)}; got {s} "
+            "(use parallel.dense_attention for short/ragged sequences)"
+        )
+    if d > 256:
+        raise ValueError(f"head dim {d} too large for a single VMEM tile")
+    if 2 * s * d * 4 > _VMEM_OPERAND_BUDGET:
+        raise ValueError(
+            f"S={s}, D={d} exceeds the kernel's VMEM-resident ceiling "
+            f"(2*S*D*4 > {_VMEM_OPERAND_BUDGET} bytes); shard the sequence "
+            "over a mesh with parallel.ring_attention instead"
+        )
+
+
+def _fwd(q3, k3, v3, causal: bool, scale: float):
+    bh, s, d = q3.shape
+    grid = (bh, s // _BQ)
+    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0))
+    kvspec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, s=s, causal=causal,
+                          scale=scale),
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec, pl.BlockSpec((1, _BQ, 1), lambda b, i: (b, i, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash3(q3, k3, v3, causal: bool, scale: float):
+    return _fwd(q3, k3, v3, causal, scale)[0]
+
+
+def _flash3_fwd(q3, k3, v3, causal, scale):
+    o, lse = _fwd(q3, k3, v3, causal, scale)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(causal, scale, res, do):
+    q3, k3, v3, o, lse = res
+    bh, s, d = q3.shape
+    do = do.astype(jnp.float32)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [BH, S, 1]
+
+    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0))
+    q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i: (b, i, 0))
+    full = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    full1 = pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0))
+    kspec = pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, s=s, causal=causal,
+                          scale=scale),
+        grid=(bh, s // _BQ),
+        in_specs=[qspec, full, full, qspec, q1spec, q1spec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        interpret=_interpret(),
+    )(q3, k3, v3, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, s=s, causal=causal,
+                          scale=scale),
+        grid=(bh, s // _BK),
+        in_specs=[full, kspec, kspec, full, full1, full1],
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do, lse, delta)
+
+    return dq, dk, dv
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention, blockwise in VMEM. q,k,v: [B, S, H, D] -> same.
+
+    Drop-in for `parallel.dense_attention` at long S (S must be a
+    multiple of 128): no [S, S] score matrix ever exists in HBM, forward
+    or backward.
+    """
+    b, s, h, d = q.shape
+    _check_shapes(s, d)
+    scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(jnp.float32)
+
+    o = _flash3(to3(q), to3(k), to3(v), causal, float(scale))
+    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)
