@@ -14,14 +14,24 @@ the standard flash-2 backward from the saved per-row logsumexp:
           dQ_i = sum_j dS_ij K_j * scale,  dK_j = sum_i dS_ij^T Q_i * scale
           with P recomputed blockwise from (Q, K, L).
 
-Layout: kernels take `[S, D]` per (batch, head) and the grid's leading
-axis sweeps B*H — Q/K/V arrive as `[BH, S, D]`. The public entry
-`flash_attention(q, k, v)` keeps the framework's `[B, S, H, D]`
-convention of `parallel/ring.py` and is a drop-in for `dense_attention`
-(same signature semantics, exact same math — tests/test_flash.py).
-Composable with sequence parallelism: inside a `seq`-axis shard_map each
-device can run this kernel on its resident block while `ring_attention`
-handles the cross-device streaming.
+Memory: NOTHING is whole-sequence-resident in VMEM. Every kernel runs a
+3-D grid `(batch*head, outer block, streamed block)` — the streamed
+operand (KV for fwd/dq, Q/dO for dk/dv) enters one `[128, D]` tile per
+grid step through its BlockSpec while accumulators live in VMEM scratch,
+initialized on the first streamed step and flushed to the revisited
+output block on the last. Sequence length is therefore HBM-bound, not
+VMEM-bound. Causal skipping is `@pl.when` predication on the streamed
+index (the tile DMA still happens; the compute does not).
+
+Layout: kernels take `[S, D]` per (batch, head) — Q/K/V arrive as
+`[BH, S, D]`. The public entry `flash_attention(q, k, v)` keeps the
+framework's `[B, S, H, D]` convention of `parallel/ring.py` and is a
+drop-in for `dense_attention` (same signature, exact same math —
+tests/test_flash.py). Composable with sequence parallelism: inside a
+`seq`-axis shard_map each device can run this kernel on its resident
+block while `ring_attention` handles the cross-device streaming. MXU
+dots are pinned to HIGHEST precision — the f32 reference comparison
+exposes the default fast-precision passes at long S.
 
 Off-TPU the kernels run in Pallas interpret mode, so CPU tests exercise
 the exact code path the TPU compiles.
@@ -35,155 +45,152 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
 
-# Q/KV tile heights. 128 matches the MXU systolic edge; S must be a
-# multiple (the LM/ViT sequence lengths are powers of two — assert, don't
-# silently pad, so callers see the constraint).
+# Tile heights. 128 matches the MXU systolic edge; S must be a multiple
+# (the LM/ViT sequence lengths are powers of two — assert, don't silently
+# pad, so callers see the constraint).
 _BQ = 128
 _BK = 128
+# the causal skip predicates (j <= qi / i >= ki) assume equal tile
+# heights; retuning one constant requires reinstating block-ratio bounds
+assert _BQ == _BK
+
+_HI = jax.lax.Precision.HIGHEST
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, s: int, causal: bool,
-                scale: float):
-    qi = pl.program_id(1)
-    q = q_ref[0] * scale  # [BQ, D]
-    d = q.shape[-1]
-    nkv = s // _BK
+def _dot(a, b, dims):
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())), preferred_element_type=jnp.float32,
+        precision=_HI,
+    )
 
-    def body(j, carry):
-        o, m, l = carry
-        k = k_ref[0, pl.ds(j * _BK, _BK), :]  # [BK, D]
-        v = v_ref[0, pl.ds(j * _BK, _BK), :]
-        sc = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )  # [BQ, BK]
+
+def _causal_mask(sc, qblk, kblk):
+    qpos = qblk * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
+    kpos = kblk * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+    return jnp.where(kpos <= qpos, sc, _NEG_BIG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
+                *, nkv: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)  # streamed KV block
+
+    @pl.when(j == 0)
+    def _():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, _NEG_BIG)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    def compute():
+        q = q_ref[0] * scale  # [BQ, D]
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]
+        sc = _dot(q, k, (((1,), (1,))))  # [BQ, BK]
         if causal:
-            qpos = qi * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
-            kpos = j * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
-            sc = jnp.where(kpos <= qpos, sc, _NEG_BIG)
+            sc = _causal_mask(sc, qi, j)
+        m = m_acc[:, 0]
+        l = l_acc[:, 0]
         m_new = jnp.maximum(m, jnp.max(sc, axis=1))
         p = jnp.exp(sc - m_new[:, None])
         corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=1)
-        o = o * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        return o, m_new, l
+        l_new = l * corr + jnp.sum(p, axis=1)
+        o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, (((1,), (0,))))
+        m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
+        l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
-    o0 = jnp.zeros((_BQ, d), jnp.float32)
-    m0 = jnp.full((_BQ,), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((_BQ,), jnp.float32)
-    # causal: KV blocks past this Q block are fully masked — skip them
-    upper = (qi + 1) * _BQ // _BK if causal else nkv
-    o, m, l = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
+    if causal:
+        # KV blocks past this Q block are fully masked — no compute
+        pl.when(j <= qi)(compute)
+    else:
+        compute()
 
-    o_ref[0] = o / l[:, None]
-    lse_ref[0] = (m + jnp.log(l))[:, None]
+    @pl.when(j == nkv - 1)
+    def _():
+        l = l_acc[:, 0]
+        m = m_acc[:, 0]
+        o_ref[0] = o_acc[:] / l[:, None]
+        lse_ref[0] = (m + jnp.log(l))[:, None]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, s: int, causal: bool, scale: float):
+                   dq_acc, *, nkv: int, causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0]  # [BQ, D] (unscaled)
-    do = do_ref[0]
-    lse = lse_ref[0][:, 0]
-    delta = delta_ref[0][:, 0]
-    d = q.shape[-1]
-    nkv = s // _BK
+    j = pl.program_id(2)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * _BK, _BK), :]
-        v = v_ref[0, pl.ds(j * _BK, _BK), :]
-        sc = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0]  # [BQ, D] (unscaled)
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        k = k_ref[0]
+        v = v_ref[0]
+        sc = _dot(q * scale, k, (((1,), (1,))))
         if causal:
-            qpos = qi * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
-            kpos = j * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
-            sc = jnp.where(kpos <= qpos, sc, _NEG_BIG)
+            sc = _causal_mask(sc, qi, j)
         p = jnp.exp(sc - lse[:, None])  # [BQ, BK]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dp = _dot(do, v, (((1,), (1,))))
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dq_acc[:] = dq_acc[:] + _dot(ds, k, (((1,), (0,))))
 
-    upper = (qi + 1) * _BQ // _BK if causal else nkv
-    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((_BQ, d), jnp.float32))
-    dq_ref[0] = dq * scale
+    if causal:
+        pl.when(j <= qi)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nkv - 1)
+    def _():
+        dq_ref[0] = dq_acc[:] * scale
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, s: int, causal: bool, scale: float):
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, nq: int, causal: bool, scale: float):
     ki = pl.program_id(1)
-    k = k_ref[0]  # [BK, D]
-    v = v_ref[0]
-    d = k.shape[-1]
-    nq = s // _BQ
+    i = pl.program_id(2)  # streamed Q block
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * _BQ, _BQ), :]
-        do = do_ref[0, pl.ds(i * _BQ, _BQ), :]
-        lse = lse_ref[0, pl.ds(i * _BQ, _BQ), :][:, 0]
-        delta = delta_ref[0, pl.ds(i * _BQ, _BQ), :][:, 0]
-        sc = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )  # [BQ, BK]
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        sc = _dot(q * scale, k, (((1,), (1,))))  # [BQ, BK]
         if causal:
-            qpos = i * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
-            kpos = ki * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
-            sc = jnp.where(kpos <= qpos, sc, _NEG_BIG)
+            sc = _causal_mask(sc, i, ki)
         p = jnp.exp(sc - lse[:, None])
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dv_acc[:] = dv_acc[:] + _dot(p, do, (((0,), (0,))))
+        dp = _dot(do, v, (((1,), (1,))))
         ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        return dk, dv
+        dk_acc[:] = dk_acc[:] + _dot(ds, q, (((0,), (0,))))
 
-    # causal: Q blocks before this KV block see none of it — skip them
-    lower = ki * _BK // _BQ if causal else 0
-    dk, dv = jax.lax.fori_loop(
-        lower, nq, body,
-        (jnp.zeros((_BK, d), jnp.float32), jnp.zeros((_BK, d), jnp.float32)),
-    )
-    dk_ref[0] = dk * scale
-    dv_ref[0] = dv
+    if causal:
+        # Q blocks before this KV block see none of it
+        pl.when(i >= ki)(compute)
+    else:
+        compute()
 
-
-# The kernels keep each (batch, head)'s full K/V (forward, dq) or Q/dO
-# (dk/dv) resident in VMEM and stream tiles out of them with pl.ds — so
-# S·D per operand is VMEM-bounded. ~8 MB for the two resident operands
-# leaves room for tiles/accumulators in a ~16 MB VMEM: S ≤ 16384 at
-# D=64. Past that, the KV/Q stream must move to a grid dimension with
-# scratch-carried accumulators (future work); the guard makes the
-# ceiling loud instead of letting Mosaic fail obscurely.
-_VMEM_OPERAND_BUDGET = 8 * 1024 * 1024
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:] * scale
+        dv_ref[0] = dv_acc[:]
 
 
 def _check_shapes(s: int, d: int):
@@ -194,28 +201,26 @@ def _check_shapes(s: int, d: int):
         )
     if d > 256:
         raise ValueError(f"head dim {d} too large for a single VMEM tile")
-    if 2 * s * d * 4 > _VMEM_OPERAND_BUDGET:
-        raise ValueError(
-            f"S={s}, D={d} exceeds the kernel's VMEM-resident ceiling "
-            f"(2*S*D*4 > {_VMEM_OPERAND_BUDGET} bytes); shard the sequence "
-            "over a mesh with parallel.ring_attention instead"
-        )
 
 
 def _fwd(q3, k3, v3, causal: bool, scale: float):
     bh, s, d = q3.shape
-    grid = (bh, s // _BQ)
-    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0))
-    kvspec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    nq, nkv = s // _BQ, s // _BK
+    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j: (b, i, 0))
+    kvspec = pl.BlockSpec((1, _BK, d), lambda b, i, j: (b, j, 0))
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, s=s, causal=causal,
-                          scale=scale),
-        grid=grid,
+        functools.partial(_fwd_kernel, nkv=nkv, causal=causal, scale=scale),
+        grid=(bh, nq, nkv),
         in_specs=[qspec, kvspec, kvspec],
-        out_specs=[qspec, pl.BlockSpec((1, _BQ, 1), lambda b, i: (b, i, 0))],
+        out_specs=[qspec, pl.BlockSpec((1, _BQ, 1), lambda b, i, j: (b, i, 0))],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_BQ, d), jnp.float32),    # o accumulator
+            pltpu.VMEM((_BQ, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((_BQ, 128), jnp.float32),  # running sum-exp (col 0)
         ],
         interpret=_interpret(),
     )(q3, k3, v3)
@@ -235,34 +240,40 @@ def _flash3_fwd(q3, k3, v3, causal, scale):
 def _flash3_bwd(causal, scale, res, do):
     q3, k3, v3, o, lse = res
     bh, s, d = q3.shape
+    nq, nkv = s // _BQ, s // _BK
     do = do.astype(jnp.float32)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [BH, S, 1]
 
-    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0))
-    q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i: (b, i, 0))
-    full = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
-    full1 = pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0))
-    kspec = pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0))
-
+    # dq: outer = Q blocks, streamed = KV blocks
+    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j: (b, i, 0))
+    q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i, j: (b, i, 0))
+    kvspec = pl.BlockSpec((1, _BK, d), lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, s=s, causal=causal,
-                          scale=scale),
-        grid=(bh, s // _BQ),
-        in_specs=[qspec, full, full, qspec, q1spec, q1spec],
+        functools.partial(_bwd_dq_kernel, nkv=nkv, causal=causal, scale=scale),
+        grid=(bh, nq, nkv),
+        in_specs=[qspec, kvspec, kvspec, qspec, q1spec, q1spec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_BQ, d), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do, lse, delta)
 
+    # dk/dv: outer = KV blocks, streamed = Q blocks
+    kspec = pl.BlockSpec((1, _BK, d), lambda b, j, i: (b, j, 0))
+    qstream = pl.BlockSpec((1, _BQ, d), lambda b, j, i: (b, i, 0))
+    q1stream = pl.BlockSpec((1, _BQ, 1), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, s=s, causal=causal,
-                          scale=scale),
-        grid=(bh, s // _BK),
-        in_specs=[full, kspec, kspec, full, full1, full1],
+        functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale),
+        grid=(bh, nkv, nq),
+        in_specs=[qstream, kspec, kspec, qstream, q1stream, q1stream],
         out_specs=[kspec, kspec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_BK, d), jnp.float32),
+            pltpu.VMEM((_BK, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(q3, k3, v3, do, lse, delta)
@@ -283,11 +294,16 @@ def flash_attention(
     """Exact attention, blockwise in VMEM. q,k,v: [B, S, H, D] -> same.
 
     Drop-in for `parallel.dense_attention` at long S (S must be a
-    multiple of 128): no [S, S] score matrix ever exists in HBM, forward
-    or backward.
+    multiple of 128): no [S, S] score matrix ever exists in HBM, nothing
+    whole-sequence-resident ever sits in VMEM, forward or backward.
     """
     b, s, h, d = q.shape
     _check_shapes(s, d)
+    if sm_scale is not None and not isinstance(sm_scale, (int, float)):
+        raise TypeError(
+            "sm_scale must be a static Python float (it is baked into the "
+            "kernel); close over it rather than passing a traced value"
+        )
     scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
 
     def to3(x):
