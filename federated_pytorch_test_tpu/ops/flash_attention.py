@@ -65,11 +65,36 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# dot_general contracting specs: last-with-last ([M,D]x[N,D] -> [M,N]),
+# last-with-first ([M,N]x[N,D] -> [M,D]), first-with-first (transpose-left)
+_LL = ((1,), (1,))
+_LF = ((1,), (0,))
+_FF = ((0,), (0,))
+
+
 def _dot(a, b, dims):
     return jax.lax.dot_general(
         a, b, (dims, ((), ())), preferred_element_type=jnp.float32,
         precision=_HI,
     )
+
+
+def _p_block(q, k, lse, qblk, kblk, causal, scale):
+    """Recompute the probability tile P = exp(S*scale - lse) for one
+    (Q block, KV block) pair — shared by both backward kernels."""
+    sc = _dot(q * scale, k, _LL)  # [BQ, BK]
+    if causal:
+        sc = _causal_mask(sc, qblk, kblk)
+    return jnp.exp(sc - lse[:, None])
+
+
+def _run_unless_skipped(causal, keep_pred, compute):
+    """Predicate the streamed-step compute on the causal skip (compute
+    runs unconditionally when not causal)."""
+    if causal:
+        pl.when(keep_pred)(compute)
+    else:
+        compute()
 
 
 def _causal_mask(sc, qblk, kblk):
@@ -93,7 +118,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
         q = q_ref[0] * scale  # [BQ, D]
         k = k_ref[0]  # [BK, D]
         v = v_ref[0]
-        sc = _dot(q, k, (((1,), (1,))))  # [BQ, BK]
+        sc = _dot(q, k, _LL)  # [BQ, BK]
         if causal:
             sc = _causal_mask(sc, qi, j)
         m = m_acc[:, 0]
@@ -102,15 +127,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
         p = jnp.exp(sc - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1)
-        o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, (((1,), (0,))))
+        o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, _LF)
         m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
         l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
-    if causal:
-        # KV blocks past this Q block are fully masked — no compute
-        pl.when(j <= qi)(compute)
-    else:
-        compute()
+    # causal: KV blocks past this Q block are fully masked
+    _run_unless_skipped(causal, j <= qi, compute)
 
     @pl.when(j == nkv - 1)
     def _():
@@ -130,24 +152,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def compute():
-        q = q_ref[0]  # [BQ, D] (unscaled)
         do = do_ref[0]
-        lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
         k = k_ref[0]
-        v = v_ref[0]
-        sc = _dot(q * scale, k, (((1,), (1,))))
-        if causal:
-            sc = _causal_mask(sc, qi, j)
-        p = jnp.exp(sc - lse[:, None])  # [BQ, BK]
-        dp = _dot(do, v, (((1,), (1,))))
+        p = _p_block(q_ref[0], k, lse_ref[0][:, 0], qi, j, causal, scale)
+        dp = _dot(do, v_ref[0], _LL)
         ds = p * (dp - delta[:, None])
-        dq_acc[:] = dq_acc[:] + _dot(ds, k, (((1,), (0,))))
+        dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF)
 
-    if causal:
-        pl.when(j <= qi)(compute)
-    else:
-        compute()
+    _run_unless_skipped(causal, j <= qi, compute)
 
     @pl.when(j == nkv - 1)
     def _():
@@ -166,26 +179,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def compute():
-        k = k_ref[0]  # [BK, D]
-        v = v_ref[0]
         q = q_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        sc = _dot(q * scale, k, (((1,), (1,))))  # [BQ, BK]
-        if causal:
-            sc = _causal_mask(sc, i, ki)
-        p = jnp.exp(sc - lse[:, None])
-        dv_acc[:] = dv_acc[:] + _dot(p, do, (((0,), (0,))))
-        dp = _dot(do, v, (((1,), (1,))))
+        p = _p_block(q, k_ref[0], lse_ref[0][:, 0], i, ki, causal, scale)
+        dv_acc[:] = dv_acc[:] + _dot(p, do, _FF)
+        dp = _dot(do, v_ref[0], _LL)
         ds = p * (dp - delta[:, None])
-        dk_acc[:] = dk_acc[:] + _dot(ds, q, (((0,), (0,))))
+        dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF)
 
-    if causal:
-        # Q blocks before this KV block see none of it
-        pl.when(i >= ki)(compute)
-    else:
-        compute()
+    # causal: Q blocks before this KV block see none of it
+    _run_unless_skipped(causal, i >= ki, compute)
 
     @pl.when(i == nq - 1)
     def _():
@@ -207,7 +211,13 @@ def _fwd(q3, k3, v3, causal: bool, scale: float):
     bh, s, d = q3.shape
     nq, nkv = s // _BQ, s // _BK
     qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j: (b, i, 0))
-    kvspec = pl.BlockSpec((1, _BK, d), lambda b, i, j: (b, j, 0))
+    # causal: fully-masked steps (j > i) revisit the resident tile — the
+    # repeated block index makes the DMA a no-op, so skipped blocks cost
+    # neither bandwidth nor compute
+    kvdx = (lambda b, i, j: (b, jnp.minimum(j, i), 0)) if causal else (
+        lambda b, i, j: (b, j, 0)
+    )
+    kvspec = pl.BlockSpec((1, _BK, d), kvdx)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, nkv=nkv, causal=causal, scale=scale),
         grid=(bh, nq, nkv),
@@ -244,10 +254,14 @@ def _flash3_bwd(causal, scale, res, do):
     do = do.astype(jnp.float32)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [BH, S, 1]
 
-    # dq: outer = Q blocks, streamed = KV blocks
+    # dq: outer = Q blocks, streamed = KV blocks (causal: clamp skipped
+    # steps onto the resident tile — no-op DMA, see _fwd)
     qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j: (b, i, 0))
     q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i, j: (b, i, 0))
-    kvspec = pl.BlockSpec((1, _BK, d), lambda b, i, j: (b, j, 0))
+    kvdx = (lambda b, i, j: (b, jnp.minimum(j, i), 0)) if causal else (
+        lambda b, i, j: (b, j, 0)
+    )
+    kvspec = pl.BlockSpec((1, _BK, d), kvdx)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, nkv=nkv, causal=causal, scale=scale),
         grid=(bh, nq, nkv),
@@ -258,10 +272,17 @@ def _flash3_bwd(causal, scale, res, do):
         interpret=_interpret(),
     )(q3, k3, v3, do, lse, delta)
 
-    # dk/dv: outer = KV blocks, streamed = Q blocks
+    # dk/dv: outer = KV blocks, streamed = Q blocks (causal: Q blocks
+    # before the KV block are skipped — clamp them onto the resident tile)
     kspec = pl.BlockSpec((1, _BK, d), lambda b, j, i: (b, j, 0))
-    qstream = pl.BlockSpec((1, _BQ, d), lambda b, j, i: (b, i, 0))
-    q1stream = pl.BlockSpec((1, _BQ, 1), lambda b, j, i: (b, i, 0))
+    qdx = (lambda b, j, i: (b, jnp.maximum(i, j), 0)) if causal else (
+        lambda b, j, i: (b, i, 0)
+    )
+    q1dx = (lambda b, j, i: (b, jnp.maximum(i, j), 0)) if causal else (
+        lambda b, j, i: (b, i, 0)
+    )
+    qstream = pl.BlockSpec((1, _BQ, d), qdx)
+    q1stream = pl.BlockSpec((1, _BQ, 1), q1dx)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale),
         grid=(bh, nkv, nq),
@@ -299,12 +320,12 @@ def flash_attention(
     """
     b, s, h, d = q.shape
     _check_shapes(s, d)
-    if sm_scale is not None and not isinstance(sm_scale, (int, float)):
+    if isinstance(sm_scale, jax.core.Tracer):
         raise TypeError(
-            "sm_scale must be a static Python float (it is baked into the "
-            "kernel); close over it rather than passing a traced value"
+            "sm_scale must be static (it is baked into the kernel); close "
+            "over it rather than passing a traced value"
         )
-    scale = sm_scale if sm_scale is not None else 1.0 / (float(d) ** 0.5)
+    scale = float(sm_scale) if sm_scale is not None else 1.0 / (float(d) ** 0.5)
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(jnp.float32)

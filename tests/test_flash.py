@@ -83,6 +83,13 @@ def test_flash_custom_scale_and_jit():
     ref = dense_attention(q, k, v, sm_scale=0.07)
     out = jax.jit(lambda *a: flash_attention(*a, sm_scale=0.07))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
+    # static numpy scalars are fine; only traced values are rejected
+    out = flash_attention(q, k, v, sm_scale=np.float32(0.07))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
+    with pytest.raises(TypeError, match="static"):
+        jax.jit(lambda q, k, v, sc: flash_attention(q, k, v, sm_scale=sc))(
+            q, k, v, jnp.float32(0.07)
+        )
 
 
 def test_flash_rejects_ragged_seq():
