@@ -12,9 +12,13 @@ Attention is pluggable: `attn_impl='dense'` runs the single-device
 reference path; `attn_impl='flash'` runs the Pallas blockwise kernels
 (ops/flash_attention.py — no [S, S] scores in HBM, the single-device
 long-context path); `attn_impl='ring'` runs ring attention over the `seq`
-mesh axis (parallel/ring.py) for sequences sharded across devices. The
-model code is identical in every case, which is the point: how attention
-executes is a property of the call site, not a fork of the model.
+mesh axis (parallel/ring.py) for sequences sharded across devices;
+`attn_impl='ring_flash'` composes the two — the ring streams K/V blocks
+over ICI while the Pallas kernel streams VMEM tiles within each device,
+so neither the global nor the local sequence length is score-matrix-
+bound. The model code is identical in every case, which is the point:
+how attention executes is a property of the call site, not a fork of
+the model.
 """
 
 from __future__ import annotations
@@ -41,17 +45,17 @@ class MultiHeadAttention(nn.Module):
 
     dim: int
     num_heads: int
-    attn_impl: str = "dense"  # 'dense' | 'ring' | 'flash'
+    attn_impl: str = "dense"  # 'dense' | 'ring' | 'flash' | 'ring_flash'
     causal: bool = False
     seq_axis: str = SEQ_AXIS
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        if self.attn_impl not in ("dense", "ring", "flash"):
+        if self.attn_impl not in ("dense", "ring", "flash", "ring_flash"):
             raise ValueError(
-                f"attn_impl must be 'dense', 'ring' or 'flash', "
-                f"got {self.attn_impl!r}"
+                f"attn_impl must be 'dense', 'ring', 'flash' or "
+                f"'ring_flash', got {self.attn_impl!r}"
             )
         b, s, _ = x.shape
         h, hd = self.num_heads, self.dim // self.num_heads
@@ -65,8 +69,14 @@ class MultiHeadAttention(nn.Module):
         q, k, v = jnp.split(
             qkv.reshape(b, s, 3 * h, hd).astype(jnp.float32), 3, axis=2
         )
-        if self.attn_impl == "ring":
-            out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        if self.attn_impl in ("ring", "ring_flash"):
+            # 'ring_flash' = same ring schedule with the Pallas flash
+            # kernel as each step's block compute (two-level streaming:
+            # ICI across devices, VMEM tiles within)
+            out = ring_attention(
+                q, k, v, axis_name=self.seq_axis, causal=self.causal,
+                use_flash=self.attn_impl == "ring_flash",
+            )
         elif self.attn_impl == "flash":
             # Pallas blockwise kernels (ops/flash_attention.py): no [S, S]
             # scores in HBM — the long-context single-device path

@@ -23,15 +23,29 @@ output block on the last. Sequence length is therefore HBM-bound, not
 VMEM-bound. Causal skipping is `@pl.when` predication on the streamed
 index (the tile DMA still happens; the compute does not).
 
+Global-position offsets: every kernel takes an int32 `[q_off, k_off]`
+scalar-prefetch operand placing this call's Q and K/V blocks on the
+GLOBAL sequence axis, so the causal mask compares `k_off + kcol <=
+q_off + qrow`. The single-device entry `flash_attention` passes (0, 0);
+`flash_block` takes device-varying offsets and additionally returns the
+per-row logsumexp — that pair is exactly the partial result
+`ring_attention(use_flash=True)` (parallel/ring.py) folds across ring
+steps, composing sequence parallelism with the VMEM-blockwise kernel:
+the ring streams K/V blocks across devices over ICI while this kernel
+streams tiles within the device. A KV block entirely in a causal Q row's
+future contributes `lse = -1e30` and a zero output row, which the ring's
+online-softmax merge discards exactly. The backward treats the lse
+cotangent analytically: d lse/d scores is the softmax itself, so `dlse`
+just shifts the flash-2 `delta` term (`delta = rowsum(dO*O) - dlse`) and
+the kernels are unchanged.
+
 Layout: kernels take `[S, D]` per (batch, head) — Q/K/V arrive as
-`[BH, S, D]`. The public entry `flash_attention(q, k, v)` keeps the
-framework's `[B, S, H, D]` convention of `parallel/ring.py` and is a
+`[BH, S, D]`. The public entries keep the framework's `[B, S, H, D]`
+convention of `parallel/ring.py`; `flash_attention(q, k, v)` is a
 drop-in for `dense_attention` (same signature, exact same math —
-tests/test_flash.py). Composable with sequence parallelism: inside a
-`seq`-axis shard_map each device can run this kernel on its resident
-block while `ring_attention` handles the cross-device streaming. MXU
-dots are pinned to HIGHEST precision — the f32 reference comparison
-exposes the default fast-precision passes at long S.
+tests/test_flash.py). MXU dots are pinned to HIGHEST precision — the
+f32 reference comparison exposes the default fast-precision passes at
+long S.
 
 Off-TPU the kernels run in Pallas interpret mode, so CPU tests exercise
 the exact code path the TPU compiles.
@@ -40,7 +54,7 @@ the exact code path the TPU compiles.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,8 +68,8 @@ _NEG_BIG = -1e30
 # pad, so callers see the constraint).
 _BQ = 128
 _BK = 128
-# the causal skip predicates (j <= qi / i >= ki) assume equal tile
-# heights; retuning one constant requires reinstating block-ratio bounds
+# the causal skip/elision formulas assume equal tile heights; retuning
+# one constant requires reinstating block-ratio bounds
 assert _BQ == _BK
 
 _HI = jax.lax.Precision.HIGHEST
@@ -79,12 +93,29 @@ def _dot(a, b, dims):
     )
 
 
-def _p_block(q, k, lse, qblk, kblk, causal, scale):
+def _causal_mask(sc, qpos0, kpos0):
+    """Mask scores where global k position exceeds global q position.
+
+    `qpos0`/`kpos0` are the global positions of the tile's first row/col
+    (offset + block index * tile height); they may be traced scalars.
+    """
+    qpos = qpos0 + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
+    kpos = kpos0 + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+    return jnp.where(kpos <= qpos, sc, _NEG_BIG)
+
+
+def _p_block(q, k, lse, qpos0, kpos0, causal, scale):
     """Recompute the probability tile P = exp(S*scale - lse) for one
     (Q block, KV block) pair — shared by both backward kernels."""
     sc = _dot(q * scale, k, _LL)  # [BQ, BK]
     if causal:
-        sc = _causal_mask(sc, qblk, kblk)
+        sc = _causal_mask(sc, qpos0, kpos0)
+        # a fully-masked row has lse == sc == _NEG_BIG and exp(0) would
+        # be 1; such rows (possible for non-tile-aligned k_off - q_off,
+        # where a KEPT tile still contains maskless rows) have P == 0
+        return jnp.where(
+            (lse > _NEG_BIG * 0.5)[:, None], jnp.exp(sc - lse[:, None]), 0.0
+        )
     return jnp.exp(sc - lse[:, None])
 
 
@@ -97,14 +128,39 @@ def _run_unless_skipped(causal, keep_pred, compute):
         compute()
 
 
-def _causal_mask(sc, qblk, kblk):
-    qpos = qblk * _BQ + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
-    kpos = kblk * _BK + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
-    return jnp.where(kpos <= qpos, sc, _NEG_BIG)
+# ---------------------------------------------------------------------------
+# causal block-skip predicates and DMA-elision index maps, in terms of the
+# global offsets. A streamed block is USEFUL iff its tile overlaps the
+# lower-triangular region of the (global q, global k) plane:
+#   kv block j vs q block i:  k_off + j*BK  <=  q_off + (i+1)*BQ - 1
+# Skipped steps clamp their streamed-operand index onto the last/first
+# useful block — the repeated block index makes the DMA a no-op, so
+# skipped blocks cost neither bandwidth nor compute.
+# ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
-                *, nkv: int, causal: bool, scale: float):
+def _kv_keep(off, i, j):
+    return off[1] + j * _BK <= off[0] + (i + 1) * _BQ - 1
+
+
+def _kv_clamp(off, i, j, nkv):
+    # last useful kv block for q block i (may be <0: whole row masked)
+    jmax = (off[0] + (i + 1) * _BQ - 1 - off[1]) // _BK
+    return jnp.clip(jnp.minimum(j, jmax), 0, nkv - 1)
+
+
+def _q_keep(off, j, i):
+    return off[0] + (i + 1) * _BQ - 1 >= off[1] + j * _BK
+
+
+def _q_clamp(off, j, i, nq):
+    # first useful q block for kv block j (may be >= nq: block unseen)
+    imin = (off[1] + j * _BK - off[0]) // _BQ
+    return jnp.clip(jnp.maximum(i, imin), 0, nq - 1)
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                o_acc, m_acc, l_acc, *, nkv: int, causal: bool, scale: float):
     qi = pl.program_id(1)
     j = pl.program_id(2)  # streamed KV block
 
@@ -120,30 +176,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc,
         v = v_ref[0]
         sc = _dot(q, k, _LL)  # [BQ, BK]
         if causal:
-            sc = _causal_mask(sc, qi, j)
+            sc = _causal_mask(sc, off_ref[0] + qi * _BQ, off_ref[1] + j * _BK)
         m = m_acc[:, 0]
         l = l_acc[:, 0]
         m_new = jnp.maximum(m, jnp.max(sc, axis=1))
         p = jnp.exp(sc - m_new[:, None])
+        if causal:
+            # rows whose running max is still _NEG_BIG have seen only
+            # masked scores (sc - m_new == 0 there, NOT -inf): zero them
+            # so partially-masked tiles of non-aligned offsets stay exact
+            p = jnp.where((m_new > _NEG_BIG * 0.5)[:, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1)
         o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, _LF)
         m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
         l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
-    # causal: KV blocks past this Q block are fully masked
-    _run_unless_skipped(causal, j <= qi, compute)
+    _run_unless_skipped(causal, _kv_keep(off_ref, qi, j), compute)
 
     @pl.when(j == nkv - 1)
     def _():
         l = l_acc[:, 0]
         m = m_acc[:, 0]
-        o_ref[0] = o_acc[:] / l[:, None]
-        lse_ref[0] = (m + jnp.log(l))[:, None]
+        # rows with no visible key (possible when k_off > q positions in
+        # the ring's off-diagonal blocks): emit 0 output and -BIG lse so
+        # the caller's online-softmax merge gives them zero weight
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = o_acc[:] / l_safe[:, None]
+        lse_ref[0] = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_BIG)[:, None]
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, nkv: int, causal: bool, scale: float):
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, nkv: int, causal: bool, scale: float):
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -155,19 +219,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         delta = delta_ref[0][:, 0]
         k = k_ref[0]
-        p = _p_block(q_ref[0], k, lse_ref[0][:, 0], qi, j, causal, scale)
+        p = _p_block(q_ref[0], k, lse_ref[0][:, 0],
+                     off_ref[0] + qi * _BQ, off_ref[1] + j * _BK,
+                     causal, scale)
         dp = _dot(do, v_ref[0], _LL)
         ds = p * (dp - delta[:, None])
         dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF)
 
-    _run_unless_skipped(causal, j <= qi, compute)
+    _run_unless_skipped(causal, _kv_keep(off_ref, qi, j), compute)
 
     @pl.when(j == nkv - 1)
     def _():
         dq_ref[0] = dq_acc[:] * scale
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, nq: int, causal: bool, scale: float):
     ki = pl.program_id(1)
@@ -182,14 +248,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         do = do_ref[0]
         delta = delta_ref[0][:, 0]
-        p = _p_block(q, k_ref[0], lse_ref[0][:, 0], i, ki, causal, scale)
+        p = _p_block(q, k_ref[0], lse_ref[0][:, 0],
+                     off_ref[0] + i * _BQ, off_ref[1] + ki * _BK,
+                     causal, scale)
         dv_acc[:] = dv_acc[:] + _dot(p, do, _FF)
         dp = _dot(do, v_ref[0], _LL)
         ds = p * (dp - delta[:, None])
         dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF)
 
-    # causal: Q blocks before this KV block see none of it
-    _run_unless_skipped(causal, i >= ki, compute)
+    _run_unless_skipped(causal, _q_keep(off_ref, ki, i), compute)
 
     @pl.when(i == nq - 1)
     def _():
@@ -197,112 +264,148 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:]
 
 
-def _check_shapes(s: int, d: int):
-    if s % _BQ != 0 or s % _BK != 0:
+def _check_shapes(s_q: int, s_kv: int, d: int):
+    if s_q % _BQ != 0 or s_kv % _BK != 0:
         raise ValueError(
-            f"flash attention needs S divisible by {max(_BQ, _BK)}; got {s} "
+            f"flash attention needs S divisible by {max(_BQ, _BK)}; got "
+            f"({s_q}, {s_kv}) "
             "(use parallel.dense_attention for short/ragged sequences)"
         )
     if d > 256:
         raise ValueError(f"head dim {d} too large for a single VMEM tile")
 
 
-def _fwd(q3, k3, v3, causal: bool, scale: float):
-    bh, s, d = q3.shape
-    nq, nkv = s // _BQ, s // _BK
-    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j: (b, i, 0))
-    # causal: fully-masked steps (j > i) revisit the resident tile — the
-    # repeated block index makes the DMA a no-op, so skipped blocks cost
-    # neither bandwidth nor compute
-    kvdx = (lambda b, i, j: (b, jnp.minimum(j, i), 0)) if causal else (
-        lambda b, i, j: (b, j, 0)
+def _grid_spec(grid, in_specs, out_specs, scratch_shapes):
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+
+
+def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None):
+    bh, s_q, d = q3.shape
+    s_kv = k3.shape[1]
+    nq, nkv = s_q // _BQ, s_kv // _BK
+    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j, off: (b, i, 0))
+    kvdx = (
+        (lambda b, i, j, off: (b, _kv_clamp(off, i, j, nkv), 0))
+        if causal
+        else (lambda b, i, j, off: (b, j, 0))
     )
     kvspec = pl.BlockSpec((1, _BK, d), kvdx)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, nkv=nkv, causal=causal, scale=scale),
-        grid=(bh, nq, nkv),
-        in_specs=[qspec, kvspec, kvspec],
-        out_specs=[qspec, pl.BlockSpec((1, _BQ, 1), lambda b, i, j: (b, i, 0))],
+        grid_spec=_grid_spec(
+            (bh, nq, nkv),
+            [qspec, kvspec, kvspec],
+            [qspec, pl.BlockSpec((1, _BQ, 1), lambda b, i, j, off: (b, i, 0))],
+            [
+                pltpu.VMEM((_BQ, d), jnp.float32),    # o accumulator
+                pltpu.VMEM((_BQ, 128), jnp.float32),  # running max (col 0)
+                pltpu.VMEM((_BQ, 128), jnp.float32),  # running sum-exp (col 0)
+            ],
+        ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((_BQ, d), jnp.float32),    # o accumulator
-            pltpu.VMEM((_BQ, 128), jnp.float32),  # running max (col 0)
-            pltpu.VMEM((_BQ, 128), jnp.float32),  # running sum-exp (col 0)
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32, vma=vma),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(off, q3, k3, v3)
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash3(q3, k3, v3, causal: bool, scale: float):
-    return _fwd(q3, k3, v3, causal, scale)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash3(q3, k3, v3, off, causal: bool, scale: float, vma=None):
+    return _fwd(q3, k3, v3, off, causal, scale, vma)
 
 
-def _flash3_fwd(q3, k3, v3, causal, scale):
-    o, lse = _fwd(q3, k3, v3, causal, scale)
-    return o, (q3, k3, v3, o, lse)
+def _flash3_fwd(q3, k3, v3, off, causal, scale, vma):
+    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma)
+    return (o, lse), (q3, k3, v3, off, o, lse)
 
 
-def _flash3_bwd(causal, scale, res, do):
-    q3, k3, v3, o, lse = res
-    bh, s, d = q3.shape
-    nq, nkv = s // _BQ, s // _BK
+def _flash3_bwd(causal, scale, vma, res, cts):
+    q3, k3, v3, off, o, lse = res
+    do, dlse = cts
+    bh, s_q, d = q3.shape
+    s_kv = k3.shape[1]
+    nq, nkv = s_q // _BQ, s_kv // _BK
     do = do.astype(jnp.float32)
-    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [BH, S, 1]
+    # d lse/d scores is the softmax P itself, so the lse cotangent enters
+    # dS = P (dP - delta) as a shift of delta: delta = rowsum(dO*O) - dlse
+    delta = jnp.sum(do * o, axis=-1, keepdims=True) - dlse.astype(jnp.float32)
 
-    # dq: outer = Q blocks, streamed = KV blocks (causal: clamp skipped
-    # steps onto the resident tile — no-op DMA, see _fwd)
-    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j: (b, i, 0))
-    q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i, j: (b, i, 0))
-    kvdx = (lambda b, i, j: (b, jnp.minimum(j, i), 0)) if causal else (
-        lambda b, i, j: (b, j, 0)
+    # dq: outer = Q blocks, streamed = KV blocks
+    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j, off: (b, i, 0))
+    q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i, j, off: (b, i, 0))
+    kvdx = (
+        (lambda b, i, j, off: (b, _kv_clamp(off, i, j, nkv), 0))
+        if causal
+        else (lambda b, i, j, off: (b, j, 0))
     )
     kvspec = pl.BlockSpec((1, _BK, d), kvdx)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, nkv=nkv, causal=causal, scale=scale),
-        grid=(bh, nq, nkv),
-        in_specs=[qspec, kvspec, kvspec, qspec, q1spec, q1spec],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((_BQ, d), jnp.float32)],
+        grid_spec=_grid_spec(
+            (bh, nq, nkv),
+            [qspec, kvspec, kvspec, qspec, q1spec, q1spec],
+            qspec,
+            [pltpu.VMEM((_BQ, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
         interpret=_interpret(),
-    )(q3, k3, v3, do, lse, delta)
+    )(off, q3, k3, v3, do, lse, delta)
 
     # dk/dv: outer = KV blocks, streamed = Q blocks (causal: Q blocks
-    # before the KV block are skipped — clamp them onto the resident tile)
-    kspec = pl.BlockSpec((1, _BK, d), lambda b, j, i: (b, j, 0))
-    qdx = (lambda b, j, i: (b, jnp.maximum(i, j), 0)) if causal else (
-        lambda b, j, i: (b, i, 0)
-    )
-    q1dx = (lambda b, j, i: (b, jnp.maximum(i, j), 0)) if causal else (
-        lambda b, j, i: (b, i, 0)
+    # before the KV block see none of it — clamp onto the first useful)
+    kspec = pl.BlockSpec((1, _BK, d), lambda b, j, i, off: (b, j, 0))
+    qdx = (
+        (lambda b, j, i, off: (b, _q_clamp(off, j, i, nq), 0))
+        if causal
+        else (lambda b, j, i, off: (b, i, 0))
     )
     qstream = pl.BlockSpec((1, _BQ, d), qdx)
-    q1stream = pl.BlockSpec((1, _BQ, 1), q1dx)
+    q1stream = pl.BlockSpec((1, _BQ, 1), qdx)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale),
-        grid=(bh, nkv, nq),
-        in_specs=[qstream, kspec, kspec, qstream, q1stream, q1stream],
-        out_specs=[kspec, kspec],
+        grid_spec=_grid_spec(
+            (bh, nkv, nq),
+            [qstream, kspec, kspec, qstream, q1stream, q1stream],
+            [kspec, kspec],
+            [
+                pltpu.VMEM((_BK, d), jnp.float32),
+                pltpu.VMEM((_BK, d), jnp.float32),
+            ],
+        ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((_BK, d), jnp.float32),
-            pltpu.VMEM((_BK, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_kv, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_kv, d), jnp.float32, vma=vma),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3, do, lse, delta)
+    )(off, q3, k3, v3, do, lse, delta)
 
-    return dq, dk, dv
+    doff = jax.custom_derivatives.zero_from_primal(off)
+    return dq, dk, dv, doff
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def _to3(x, b, h):
+    s = x.shape[1]
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(jnp.float32)
+
+
+def _static_scale(sm_scale, d: int) -> float:
+    if isinstance(sm_scale, jax.core.Tracer):
+        raise TypeError(
+            "sm_scale must be static (it is baked into the kernel); close "
+            "over it rather than passing a traced value"
+        )
+    return float(sm_scale) if sm_scale is not None else 1.0 / (float(d) ** 0.5)
 
 
 def flash_attention(
@@ -319,16 +422,48 @@ def flash_attention(
     whole-sequence-resident ever sits in VMEM, forward or backward.
     """
     b, s, h, d = q.shape
-    _check_shapes(s, d)
-    if isinstance(sm_scale, jax.core.Tracer):
-        raise TypeError(
-            "sm_scale must be static (it is baked into the kernel); close "
-            "over it rather than passing a traced value"
-        )
-    scale = float(sm_scale) if sm_scale is not None else 1.0 / (float(d) ** 0.5)
-
-    def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(jnp.float32)
-
-    o = _flash3(to3(q), to3(k), to3(v), causal, float(scale))
+    _check_shapes(s, s, d)
+    scale = _static_scale(sm_scale, d)
+    off = jnp.zeros((2,), jnp.int32)
+    o, _ = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
+                   off, causal, scale, None)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def flash_block(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_offset,
+    k_offset,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    vma=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One (Q block, KV block) partial attention with global positions.
+
+    q: [B, Sq, H, D] at global positions `q_offset + [0, Sq)`;
+    k, v: [B, Skv, H, D] at `k_offset + [0, Skv)` (offsets may be traced,
+    device-varying scalars — e.g. `ring_attention`'s block origins).
+    Returns `(o, lse)`, both f32: o `[B, Sq, H, D]` is this block's
+    normalized attention output, lse `[B, H, Sq]` its per-row logsumexp
+    — the pair an online-softmax merge needs to fold partial blocks
+    exactly
+    (lse = -1e30 and o = 0 for causal rows that see no key in this
+    block). Differentiable in q, k, v — including through uses of lse.
+    """
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    _check_shapes(s_q, s_kv, d)
+    scale = _static_scale(sm_scale, d)
+    off = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )
+    o, lse = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
+                     off, causal, scale,
+                     frozenset(vma) if vma else None)
+    # both outputs stay f32 regardless of input dtype: partials feed an
+    # online-softmax accumulation (ring.py fold_flash) and rounding them
+    # before the merge would waste the f32 carry
+    o = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    return o, lse.reshape(b, h, s_q)

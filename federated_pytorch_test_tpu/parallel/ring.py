@@ -77,6 +77,7 @@ def ring_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on `axis_name`.
 
@@ -86,6 +87,19 @@ def ring_attention(
     each K/V block to the next neighbour, so the interconnect carries
     exactly `(P-1)/P` of K and V once — the minimum for exact attention —
     and every step's compute overlaps the next block's transfer.
+
+    `use_flash=True` swaps each ring step's block compute from the dense
+    einsum (materializes the local `[S_q, S_kv]` score tile in HBM) to the
+    Pallas flash kernel (`ops/flash_attention.flash_block`): the kernel
+    streams 128-row tiles through VMEM and returns this block's
+    `(output, logsumexp)` partial, which the same online-softmax merge
+    folds across ring steps. Two-level streaming — ring over ICI, tiles
+    within the device — so LOCAL shard length is no longer score-matrix-
+    bound either (requires S_local % 128 == 0). In Pallas interpret mode
+    (CPU tests) the enclosing shard_map needs `check_vma=False`: the
+    interpreter cannot propagate varying-mesh-axis metadata through its
+    internal slicing (compiled TPU kernels carry it via the out_shape
+    `vma` annotation).
     """
     p = lax.psum(1, axis_name)  # ring size (number of sequence shards)
     my = lax.axis_index(axis_name)
@@ -94,9 +108,8 @@ def ring_attention(
     scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(float(d))
 
     q_pos = my * s_q + jnp.arange(s_q)  # global positions of local queries
-    perm = [(j, (j + 1) % p) for j in range(p)]
 
-    def accumulate(acc, k_blk, v_blk, i):
+    def fold_dense(acc, k_blk, v_blk, i):
         """Fold one K/V block (ring step i) into the online softmax."""
         o, m, l = acc
         # the resident block started on device (my - i) mod p
@@ -119,6 +132,35 @@ def ring_attention(
         o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", probs, v_blk)
         return o_new, m_new, l_new
 
+    def fold_flash(acc, k_blk, v_blk, i):
+        """Fold one K/V block's `flash_block` partial (Pallas kernel):
+
+            m' = max(m, lse);  l' = l*e^{m-m'} + e^{lse-m'}
+            o' = o*e^{m-m'} + o_blk*e^{lse-m'}      (o_blk normalized)
+
+        Step 0 folds the diagonal (resident) block first, so by the time
+        a causal row meets a fully-masked block (lse = -1e30) its running
+        max is finite and the block's weight underflows to exactly 0.
+        """
+        from federated_pytorch_test_tpu.ops.flash_attention import flash_block
+
+        o, m, l = acc
+        src = (my - i) % p  # ring origin of the resident block
+        o_blk, lse = flash_block(
+            q, k_blk, v_blk, my * s_q, src * s_kv, causal=causal,
+            sm_scale=sm_scale, vma=(axis_name,),
+        )
+        o_blk = jnp.transpose(o_blk, (0, 2, 1, 3))  # [B,H,Sq,D]
+        m_new = jnp.maximum(m, lse)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse - m_new)
+        o_new = o * alpha[..., None] + o_blk.astype(o.dtype) * beta[..., None]
+        return o_new, m_new, l * alpha + beta
+
+    fold = fold_flash if use_flash else fold_dense
+    acc_dtype = jnp.float32 if use_flash else q.dtype
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
     def step(i, carry):
         o, m, l, k_blk, v_blk = carry
         # rotate K/V to the next neighbour, then fold the received block —
@@ -126,23 +168,23 @@ def ring_attention(
         # of K and V once
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        o, m, l = accumulate((o, m, l), k_blk, v_blk, i)
+        o, m, l = fold((o, m, l), k_blk, v_blk, i)
         return o, m, l, k_blk, v_blk
 
-    o0 = jnp.zeros((b, h, s_q, d), q.dtype)
-    m0 = jnp.full((b, h, s_q), _NEG_BIG, q.dtype)
-    l0 = jnp.zeros((b, h, s_q), q.dtype)
+    o0 = jnp.zeros((b, h, s_q, d), acc_dtype)
+    m0 = jnp.full((b, h, s_q), _NEG_BIG, acc_dtype)
+    l0 = jnp.zeros((b, h, s_q), acc_dtype)
     # constant-initialized carries are 'unvarying' over the mesh axis while
     # the loop writes varying values into them; mark them varying up front
     o0, m0, l0 = (_pvary(x, axis_name) for x in (o0, m0, l0))
     # ring step 0: the device's own resident block, no transfer needed
-    acc = accumulate((o0, m0, l0), k, v, 0)
+    acc = fold((o0, m0, l0), k, v, 0)
     o, m, l, _, _ = lax.fori_loop(1, p, step, acc + (k, v))
 
     # causal rows always see at least their own position, non-causal rows
     # see everything — l == 0 cannot happen; the maximum is pure paranoia
     o = o / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.transpose(o, (0, 2, 1, 3))  # [B, Sq, H, D]
+    return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)  # [B, Sq, H, D]
 
 
 def seq_shard(x: jnp.ndarray, axis_name: str = SEQ_AXIS):
