@@ -63,13 +63,18 @@ def main() -> None:
 
     idx = tr._epoch_indices(0, gid, 0, 0)[:steps]
     # warmup / compile (same scan length as the timed run — scan length is
-    # static, so a shorter warmup would compile a second program)
+    # static, so a shorter warmup would compile a second program).
+    # Synchronization is a SCALAR FETCH, not block_until_ready: on the
+    # remote-tunnel PJRT runtime block_until_ready returns at dispatch-ack,
+    # so only a device->host read is a true completion barrier. The timed
+    # call's inputs differ from the warmup's (flat/lstate/stats are
+    # threaded through), so no result caching can serve it.
     flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
-    jax.block_until_ready(flat)
+    float(jnp.sum(flat[:, 0]))
 
     t0 = time.perf_counter()
     flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
-    jax.block_until_ready(flat)
+    float(jnp.sum(flat[:, 0]))
     dt = time.perf_counter() - t0
 
     n_samples = steps * k * batch

@@ -44,7 +44,7 @@ with jax.default_matmul_precision("highest"):
         for j in (0, 1):
             o, lse = flash_block(qb, k[:, 128*j:128*(j+1)], v[:, 128*j:128*(j+1)],
                                  jnp.int32(128), jnp.int32(128*j), causal=True)
-            parts.append((jnp.transpose(o, (0,2,1,3)), lse))
+            parts.append((o, lse))  # o already [B,H,Sq,D]
         m = jnp.maximum(parts[0][1], parts[1][1])
         w0, w1 = (jnp.exp(l - m) for l in (parts[0][1], parts[1][1]))
         out = (parts[0][0]*w0[...,None] + parts[1][0]*w1[...,None]) / (w0+w1)[...,None]

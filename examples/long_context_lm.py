@@ -9,6 +9,12 @@ equals the dense one. On a CPU dev box run with a virtual ring:
     JAX_PLATFORMS=cpu python examples/long_context_lm.py
 
 On a TPU slice just run it — the ring rides the ICI.
+
+`ATTN_IMPL=ring_flash` swaps each ring step's block compute to the
+Pallas flash kernel (two-level streaming; needs SEQ such that every
+device's shard is a multiple of 128, e.g. SEQ=1024 on 8 devices):
+
+    ATTN_IMPL=ring_flash SEQ=1024 python examples/long_context_lm.py
 """
 
 import os
@@ -28,20 +34,30 @@ from federated_pytorch_test_tpu.optim import LBFGSConfig, lbfgs_init, lbfgs_step
 from federated_pytorch_test_tpu.parallel import SEQ_AXIS
 from federated_pytorch_test_tpu.partition import flatten_params
 
-SEQ = 512
+SEQ = int(os.environ.get("SEQ", "512"))
 VOCAB = 64
+ATTN_IMPL = os.environ.get("ATTN_IMPL", "ring")  # 'ring' | 'ring_flash'
 
 
 def main():
+    # 'dense'/'flash' would pass model validation but attend only over
+    # each device's local shard inside the seq-axis shard_map — reject
+    # them up front instead of failing the parity check obscurely
+    assert ATTN_IMPL in ("ring", "ring_flash"), ATTN_IMPL
     devs = jax.devices()
     p = len(devs)
     assert SEQ % p == 0, f"SEQ={SEQ} must be divisible by {p} devices"
+    if ATTN_IMPL == "ring_flash":
+        assert (SEQ // p) % 128 == 0, (
+            f"ring_flash needs 128-multiple shards; SEQ={SEQ} over {p} "
+            f"devices gives {SEQ // p}"
+        )
     mesh = Mesh(np.asarray(devs), (SEQ_AXIS,))
-    print(f"{p}-device sequence ring on {devs[0].platform}")
+    print(f"{p}-device sequence ring on {devs[0].platform} ({ATTN_IMPL})")
 
     # params are attention-impl-agnostic: init the dense twin (ring
     # attention needs the seq axis bound, which only exists in shard_map)
-    lm = TransformerLM(attn_impl="ring", dim=64, num_heads=4, vocab=VOCAB,
+    lm = TransformerLM(attn_impl=ATTN_IMPL, dim=64, num_heads=4, vocab=VOCAB,
                        max_len=SEQ)
     lm_dense = TransformerLM(attn_impl="dense", dim=64, num_heads=4,
                              vocab=VOCAB, max_len=SEQ)

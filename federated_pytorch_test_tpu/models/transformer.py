@@ -48,6 +48,10 @@ class MultiHeadAttention(nn.Module):
     attn_impl: str = "dense"  # 'dense' | 'ring' | 'flash' | 'ring_flash'
     causal: bool = False
     seq_axis: str = SEQ_AXIS
+    # MXU precision of the flash kernels / ring folds (None = each
+    # impl's default); 'default' = single bf16 passes, the fast choice
+    # for long-context training
+    attn_precision: Any = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -76,6 +80,7 @@ class MultiHeadAttention(nn.Module):
             out = ring_attention(
                 q, k, v, axis_name=self.seq_axis, causal=self.causal,
                 use_flash=self.attn_impl == "ring_flash",
+                precision=self.attn_precision,
             )
         elif self.attn_impl == "flash":
             # Pallas blockwise kernels (ops/flash_attention.py): no [S, S]
@@ -84,7 +89,10 @@ class MultiHeadAttention(nn.Module):
                 flash_attention,
             )
 
-            out = flash_attention(q, k, v, causal=self.causal)
+            out = flash_attention(
+                q, k, v, causal=self.causal,
+                precision=self.attn_precision or "highest",
+            )
         else:
             out = dense_attention(q, k, v, causal=self.causal)
         out = out.reshape(b, s, self.dim)
@@ -102,6 +110,7 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     attn_impl: str = "dense"
     causal: bool = False
+    attn_precision: Any = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -112,6 +121,7 @@ class Block(nn.Module):
             self.num_heads,
             attn_impl=self.attn_impl,
             causal=self.causal,
+            attn_precision=self.attn_precision,
             dtype=self.dtype,
             name="attn",
         )(y)
@@ -163,6 +173,7 @@ class TransformerLM(PartitionedModel):
     num_heads: int = 4
     max_len: int = 2048
     attn_impl: str = "dense"
+    attn_precision: Any = None
 
     @classmethod
     def input_shape(cls):
@@ -203,6 +214,7 @@ class TransformerLM(PartitionedModel):
                 self.num_heads,
                 attn_impl=self.attn_impl,
                 causal=True,
+                attn_precision=self.attn_precision,
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x)
@@ -241,6 +253,7 @@ class ViT(PartitionedModel):
     num_heads: int = 4
     patch: int = 4
     attn_impl: str = "dense"
+    attn_precision: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -265,6 +278,7 @@ class ViT(PartitionedModel):
                 self.dim,
                 self.num_heads,
                 attn_impl=self.attn_impl,
+                attn_precision=self.attn_precision,
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x)

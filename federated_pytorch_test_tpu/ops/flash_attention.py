@@ -86,10 +86,10 @@ _LF = ((1,), (0,))
 _FF = ((0,), (0,))
 
 
-def _dot(a, b, dims):
+def _dot(a, b, dims, prec=_HI):
     return jax.lax.dot_general(
         a, b, (dims, ((), ())), preferred_element_type=jnp.float32,
-        precision=_HI,
+        precision=prec,
     )
 
 
@@ -104,10 +104,10 @@ def _causal_mask(sc, qpos0, kpos0):
     return jnp.where(kpos <= qpos, sc, _NEG_BIG)
 
 
-def _p_block(q, k, lse, qpos0, kpos0, causal, scale):
+def _p_block(q, k, lse, qpos0, kpos0, causal, scale, prec):
     """Recompute the probability tile P = exp(S*scale - lse) for one
     (Q block, KV block) pair — shared by both backward kernels."""
-    sc = _dot(q * scale, k, _LL)  # [BQ, BK]
+    sc = _dot(q * scale, k, _LL, prec)  # [BQ, BK]
     if causal:
         sc = _causal_mask(sc, qpos0, kpos0)
         # a fully-masked row has lse == sc == _NEG_BIG and exp(0) would
@@ -160,7 +160,8 @@ def _q_clamp(off, j, i, nq):
 
 
 def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                o_acc, m_acc, l_acc, *, nkv: int, causal: bool, scale: float):
+                o_acc, m_acc, l_acc, *, nkv: int, causal: bool, scale: float,
+                prec):
     qi = pl.program_id(1)
     j = pl.program_id(2)  # streamed KV block
 
@@ -174,7 +175,7 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0] * scale  # [BQ, D]
         k = k_ref[0]  # [BK, D]
         v = v_ref[0]
-        sc = _dot(q, k, _LL)  # [BQ, BK]
+        sc = _dot(q, k, _LL, prec)  # [BQ, BK]
         if causal:
             sc = _causal_mask(sc, off_ref[0] + qi * _BQ, off_ref[1] + j * _BK)
         m = m_acc[:, 0]
@@ -188,7 +189,7 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             p = jnp.where((m_new > _NEG_BIG * 0.5)[:, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1)
-        o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, _LF)
+        o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, _LF, prec)
         m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
         l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
@@ -207,7 +208,8 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, nkv: int, causal: bool, scale: float):
+                   dq_ref, dq_acc, *, nkv: int, causal: bool, scale: float,
+                   prec):
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -221,10 +223,10 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         p = _p_block(q_ref[0], k, lse_ref[0][:, 0],
                      off_ref[0] + qi * _BQ, off_ref[1] + j * _BK,
-                     causal, scale)
-        dp = _dot(do, v_ref[0], _LL)
+                     causal, scale, prec)
+        dp = _dot(do, v_ref[0], _LL, prec)
         ds = p * (dp - delta[:, None])
-        dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF)
+        dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF, prec)
 
     _run_unless_skipped(causal, _kv_keep(off_ref, qi, j), compute)
 
@@ -235,7 +237,7 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, nq: int, causal: bool, scale: float):
+                    *, nq: int, causal: bool, scale: float, prec):
     ki = pl.program_id(1)
     i = pl.program_id(2)  # streamed Q block
 
@@ -250,11 +252,11 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, 0]
         p = _p_block(q, k_ref[0], lse_ref[0][:, 0],
                      off_ref[0] + i * _BQ, off_ref[1] + ki * _BK,
-                     causal, scale)
-        dv_acc[:] = dv_acc[:] + _dot(p, do, _FF)
-        dp = _dot(do, v_ref[0], _LL)
+                     causal, scale, prec)
+        dv_acc[:] = dv_acc[:] + _dot(p, do, _FF, prec)
+        dp = _dot(do, v_ref[0], _LL, prec)
         ds = p * (dp - delta[:, None])
-        dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF)
+        dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF, prec)
 
     _run_unless_skipped(causal, _q_keep(off_ref, ki, i), compute)
 
@@ -285,7 +287,7 @@ def _grid_spec(grid, in_specs, out_specs, scratch_shapes):
     )
 
 
-def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None):
+def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI):
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
     nq, nkv = s_q // _BQ, s_kv // _BK
@@ -297,7 +299,8 @@ def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None):
     )
     kvspec = pl.BlockSpec((1, _BK, d), kvdx)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, nkv=nkv, causal=causal, scale=scale),
+        functools.partial(_fwd_kernel, nkv=nkv, causal=causal, scale=scale,
+                          prec=prec),
         grid_spec=_grid_spec(
             (bh, nq, nkv),
             [qspec, kvspec, kvspec],
@@ -317,17 +320,17 @@ def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None):
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash3(q3, k3, v3, off, causal: bool, scale: float, vma=None):
-    return _fwd(q3, k3, v3, off, causal, scale, vma)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash3(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI):
+    return _fwd(q3, k3, v3, off, causal, scale, vma, prec)
 
 
-def _flash3_fwd(q3, k3, v3, off, causal, scale, vma):
-    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma)
+def _flash3_fwd(q3, k3, v3, off, causal, scale, vma, prec):
+    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma, prec)
     return (o, lse), (q3, k3, v3, off, o, lse)
 
 
-def _flash3_bwd(causal, scale, vma, res, cts):
+def _flash3_bwd(causal, scale, vma, prec, res, cts):
     q3, k3, v3, off, o, lse = res
     do, dlse = cts
     bh, s_q, d = q3.shape
@@ -348,7 +351,8 @@ def _flash3_bwd(causal, scale, vma, res, cts):
     )
     kvspec = pl.BlockSpec((1, _BK, d), kvdx)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, nkv=nkv, causal=causal, scale=scale),
+        functools.partial(_bwd_dq_kernel, nkv=nkv, causal=causal, scale=scale,
+                          prec=prec),
         grid_spec=_grid_spec(
             (bh, nq, nkv),
             [qspec, kvspec, kvspec, qspec, q1spec, q1spec],
@@ -370,7 +374,8 @@ def _flash3_bwd(causal, scale, vma, res, cts):
     qstream = pl.BlockSpec((1, _BQ, d), qdx)
     q1stream = pl.BlockSpec((1, _BQ, 1), qdx)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale),
+        functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale,
+                          prec=prec),
         grid_spec=_grid_spec(
             (bh, nkv, nq),
             [qstream, kspec, kspec, qstream, q1stream, q1stream],
@@ -399,6 +404,21 @@ def _to3(x, b, h):
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(jnp.float32)
 
 
+_PRECS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "default": jax.lax.Precision.DEFAULT,
+}
+
+
+def _prec_of(precision: str):
+    try:
+        return _PRECS[precision]
+    except KeyError:
+        raise ValueError(
+            f"precision must be one of {sorted(_PRECS)}, got {precision!r}"
+        ) from None
+
+
 def _static_scale(sm_scale, d: int) -> float:
     if isinstance(sm_scale, jax.core.Tracer):
         raise TypeError(
@@ -414,19 +434,26 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    precision: str = "highest",
 ) -> jnp.ndarray:
     """Exact attention, blockwise in VMEM. q,k,v: [B, S, H, D] -> same.
 
     Drop-in for `parallel.dense_attention` at long S (S must be a
     multiple of 128): no [S, S] score matrix ever exists in HBM, nothing
     whole-sequence-resident ever sits in VMEM, forward or backward.
+
+    `precision` sets the MXU pass count of every tile dot: 'highest'
+    (default) runs full-f32 passes and matches the f32 dense reference
+    to ~1e-6; 'default' runs single bf16 passes — several times faster
+    on the MXU and the standard choice for long-context training, with
+    softmax statistics and accumulators still f32.
     """
     b, s, h, d = q.shape
     _check_shapes(s, s, d)
     scale = _static_scale(sm_scale, d)
     off = jnp.zeros((2,), jnp.int32)
     o, _ = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
-                   off, causal, scale, None)
+                   off, causal, scale, None, _prec_of(precision))
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
@@ -439,15 +466,18 @@ def flash_block(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     vma=None,
+    precision: str = "highest",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One (Q block, KV block) partial attention with global positions.
 
     q: [B, Sq, H, D] at global positions `q_offset + [0, Sq)`;
     k, v: [B, Skv, H, D] at `k_offset + [0, Skv)` (offsets may be traced,
     device-varying scalars — e.g. `ring_attention`'s block origins).
-    Returns `(o, lse)`, both f32: o `[B, Sq, H, D]` is this block's
-    normalized attention output, lse `[B, H, Sq]` its per-row logsumexp
-    — the pair an online-softmax merge needs to fold partial blocks
+    Returns `(o, lse)`, both f32 and both in head-major layout — o
+    `[B, H, Sq, D]`, lse `[B, H, Sq]` — which is what an online-softmax
+    merge accumulates in (and the kernel's native layout: no transposes
+    on the fold path). o is this block's normalized attention output,
+    lse its per-row logsumexp — the pair needed to fold partial blocks
     exactly
     (lse = -1e30 and o = 0 for causal rows that see no key in this
     block). Differentiable in q, k, v — including through uses of lse.
@@ -461,9 +491,8 @@ def flash_block(
     )
     o, lse = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
                      off, causal, scale,
-                     frozenset(vma) if vma else None)
+                     frozenset(vma) if vma else None, _prec_of(precision))
     # both outputs stay f32 regardless of input dtype: partials feed an
     # online-softmax accumulation (ring.py fold_flash) and rounding them
     # before the merge would waste the f32 carry
-    o = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
-    return o, lse.reshape(b, h, s_q)
+    return o.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)

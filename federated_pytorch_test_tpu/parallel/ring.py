@@ -78,6 +78,7 @@ def ring_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     use_flash: bool = False,
+    precision: Optional[str] = None,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on `axis_name`.
 
@@ -95,12 +96,22 @@ def ring_attention(
     `(output, logsumexp)` partial, which the same online-softmax merge
     folds across ring steps. Two-level streaming — ring over ICI, tiles
     within the device — so LOCAL shard length is no longer score-matrix-
-    bound either (requires S_local % 128 == 0). In Pallas interpret mode
+    bound either (requires S_local % 128 == 0).
+
+    `precision` ('highest' | 'default' | None) applies to both folds:
+    the flash kernels' MXU pass count (None = their 'highest' default,
+    see `ops.flash_attention.flash_attention`) and the dense fold's
+    einsum precision (None = ambient default). In Pallas interpret mode
     (CPU tests) the enclosing shard_map needs `check_vma=False`: the
     interpreter cannot propagate varying-mesh-axis metadata through its
     internal slicing (compiled TPU kernels carry it via the out_shape
     `vma` annotation).
     """
+    if precision not in (None, "highest", "default"):
+        raise ValueError(
+            f"precision must be None, 'highest' or 'default', got "
+            f"{precision!r}"
+        )
     p = lax.psum(1, axis_name)  # ring size (number of sequence shards)
     my = lax.axis_index(axis_name)
     b, s_q, h, d = q.shape
@@ -108,6 +119,12 @@ def ring_attention(
     scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(float(d))
 
     q_pos = my * s_q + jnp.arange(s_q)  # global positions of local queries
+    # the precision knob applies to BOTH folds: kernel MXU passes for
+    # flash, einsum precision for dense (None = leave each at its default)
+    prec = None if precision is None else (
+        jax.lax.Precision.HIGHEST if precision == "highest"
+        else jax.lax.Precision.DEFAULT
+    )
 
     def fold_dense(acc, k_blk, v_blk, i):
         """Fold one K/V block (ring step i) into the online softmax."""
@@ -116,7 +133,9 @@ def ring_attention(
         src = (my - i) % p
         k_pos = src * s_kv + jnp.arange(s_kv)
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, precision=prec
+        ) * scale
         if causal:
             keep = (k_pos[None, :] <= q_pos[:, None])[None, None]
             scores = jnp.where(keep, scores, _NEG_BIG)
@@ -129,7 +148,9 @@ def ring_attention(
         probs = jnp.exp(scores - m_new[..., None])  # [B,H,Sq,Skv]
         corr = jnp.exp(m - m_new)  # [B,H,Sq]
         l_new = l * corr + jnp.sum(probs, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", probs, v_blk)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", probs, v_blk, precision=prec
+        )
         return o_new, m_new, l_new
 
     def fold_flash(acc, k_blk, v_blk, i):
@@ -149,8 +170,8 @@ def ring_attention(
         o_blk, lse = flash_block(
             q, k_blk, v_blk, my * s_q, src * s_kv, causal=causal,
             sm_scale=sm_scale, vma=(axis_name,),
-        )
-        o_blk = jnp.transpose(o_blk, (0, 2, 1, 3))  # [B,H,Sq,D]
+            precision=precision or "highest",
+        )  # o_blk [B,H,Sq,D]: already the accumulator layout
         m_new = jnp.maximum(m, lse)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(lse - m_new)

@@ -95,6 +95,26 @@ def test_flash_custom_scale_and_jit():
         )
 
 
+def test_flash_default_precision_mode():
+    # precision='default' (single bf16 MXU passes) must stay close to the
+    # f32 reference — loose tolerance, it exists to be fast, not exact —
+    # and gradients must flow; bogus precision names must be rejected
+    q, k, v = _qkv(b=1, s=256, h=2, d=32, seed=10)
+    ref = dense_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, precision="default")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+    g = jax.grad(
+        lambda q: jnp.sum(
+            flash_attention(q, k, v, causal=True, precision="default") ** 2
+        )
+    )(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+    with pytest.raises(ValueError, match="precision"):
+        flash_attention(q, k, v, precision="fast")
+
+
 def test_flash_rejects_ragged_seq():
     q, k, v = _qkv(s=100)
     with pytest.raises(ValueError, match="divisible"):
@@ -138,8 +158,8 @@ def test_flash_block_offsets_and_merge():
         vb = v[:, 128 * j : 128 * (j + 1), :, :]
         o, lse = flash_block(
             qb, kb, vb, jnp.int32(128), jnp.int32(128 * j), causal=True
-        )
-        o_parts.append(jnp.transpose(o, (0, 2, 1, 3)))  # [B,H,Sq,D]
+        )  # o [B,H,Sq,D]: kernel-native accumulator layout
+        o_parts.append(o)
         lse_parts.append(lse)
     m = jnp.maximum(lse_parts[0], lse_parts[1])
     w0, w1 = (jnp.exp(l - m) for l in lse_parts)
@@ -169,7 +189,8 @@ def test_flash_block_unaligned_offsets():
     q, k, v = _qkv(b=1, s=128, h=1, d=16, seed=9)
     off = 64
     o, lse = flash_block(q, k, v, jnp.int32(0), jnp.int32(off), causal=True)
-    assert float(jnp.abs(o[:, :off]).max()) == 0.0
+    # o is [B, H, Sq, D] (head-major, the merge-accumulator layout)
+    assert float(jnp.abs(o[:, :, :off]).max()) == 0.0
     assert float(lse[:, :, :off].max()) <= -1e29
     # visible rows r >= off see keys with kpos = off + col <= r
     qn, kn, vn = (np.asarray(x)[0, :, 0, :] for x in (q, k, v))
@@ -178,7 +199,7 @@ def test_flash_block_unaligned_offsets():
         pr = np.exp(sc - sc.max())
         pr /= pr.sum()
         np.testing.assert_allclose(
-            np.asarray(o)[0, row, 0, :], pr @ vn[: row - off + 1],
+            np.asarray(o)[0, 0, row, :], pr @ vn[: row - off + 1],
             rtol=3e-5, atol=3e-6, err_msg=f"row {row}",
         )
 
