@@ -46,6 +46,9 @@ def main() -> None:
         n_clients=k,
         batch=batch,
         check_results=False,
+        # convs/matmuls in bf16 on the MXU when BENCH_DTYPE=bfloat16;
+        # loss, norms and the L-BFGS math stay f32 either way
+        compute_dtype=os.environ.get("BENCH_DTYPE", "float32"),
     )
     tr = Trainer(cfg, verbose=False, source=src)
     gid = tr.group_order[0]
@@ -72,10 +75,14 @@ def main() -> None:
     flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
     float(jnp.sum(flat[:, 0]))
 
-    t0 = time.perf_counter()
-    flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
-    float(jnp.sum(flat[:, 0]))
-    dt = time.perf_counter() - t0
+    # best of 3: the tunneled chip is shared, so single-shot timings can
+    # absorb other tenants' work — the minimum is the machine's number
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
+        float(jnp.sum(flat[:, 0]))
+        dt = min(dt, time.perf_counter() - t0)
 
     n_samples = steps * k * batch
     sps = n_samples / dt
