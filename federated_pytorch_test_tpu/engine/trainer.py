@@ -29,7 +29,10 @@ from federated_pytorch_test_tpu.engine.steps import (
     build_round_init_fn,
 )
 from federated_pytorch_test_tpu.models import MODELS
+from jax.sharding import NamedSharding, PartitionSpec
+
 from federated_pytorch_test_tpu.parallel import (
+    CLIENT_AXIS,
     client_sharding,
     largest_feasible_mesh,
     mesh_size,
@@ -122,19 +125,32 @@ class Trainer:
                 order = list(rng.permutation(self.model_partition.num_groups))
             self.group_order = [int(g) for g in order]
 
-        # device placement
+        # device placement. Single-process, `_put` is jax.device_put; on a
+        # multi-process (multi-host) mesh, device_put cannot address other
+        # hosts' devices, so each process instead supplies its OWN shards
+        # from the (identical, deterministically built) host array —
+        # make_array_from_callback assembles the global array without any
+        # cross-host data motion: the multi-host data feed is just "every
+        # host indexes its slice of the same recipe"
+        def _put(x, sh):
+            if jax.process_count() == 1:
+                return jax.device_put(x, sh)  # device-side reshard, no copy
+            x = np.asarray(x)
+            return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
         csh = client_sharding(self.mesh)
         rsh = replicated_sharding(self.mesh)
-        self.flat = jax.device_put(flat, csh)
-        self.stats = jax.tree.map(lambda x: jax.device_put(x, csh), stats)
-        self.shard_imgs = jax.device_put(jnp.asarray(self.fed.train_images), csh)
-        self.shard_labels = jax.device_put(jnp.asarray(self.fed.train_labels), csh)
-        self.mean = jax.device_put(jnp.asarray(self.fed.mean), csh)
-        self.std = jax.device_put(jnp.asarray(self.fed.std), csh)
+        self._put = _put
+        self.flat = _put(flat, csh)
+        self.stats = jax.tree.map(lambda x: _put(x, csh), stats)
+        self.shard_imgs = _put(self.fed.train_images, csh)
+        self.shard_labels = _put(self.fed.train_labels, csh)
+        self.mean = _put(self.fed.mean, csh)
+        self.std = _put(self.fed.std, csh)
         t_imgs, t_labels, t_mask = self._stack_test()
-        self.test_imgs = jax.device_put(t_imgs, rsh)
-        self.test_labels = jax.device_put(t_labels, rsh)
-        self.test_mask = jax.device_put(t_mask, rsh)
+        self.test_imgs = _put(t_imgs, rsh)
+        self.test_labels = _put(t_labels, rsh)
+        self.test_mask = _put(t_mask, rsh)
 
         # per-group jitted functions, built lazily and cached
         self._epoch_fns: Dict[int, Any] = {}
@@ -156,9 +172,10 @@ class Trainer:
         if cfg.average_model:
             # one-shot whole-model average before training
             # (reference src/no_consensus_trio.py:22,134-160)
-            self.flat = jax.device_put(
-                jnp.broadcast_to(
-                    jnp.mean(self.flat, axis=0), self.flat.shape
+            host_flat = self._fetch(self.flat)
+            self.flat = self._put(
+                np.broadcast_to(
+                    host_flat.mean(axis=0), host_flat.shape
                 ).copy(),
                 csh,
             )
@@ -256,7 +273,23 @@ class Trainer:
         rng = _epoch_seed(self.cfg.seed + 69, *loop_ids)
         perms = np.stack([rng.permutation(n) for _ in range(k)])  # [K, n]
         idx = perms[:, : s * b].reshape(k, s, b).transpose(1, 0, 2)  # [S,K,B]
-        return jnp.asarray(idx, jnp.int32)
+        # committed to the epoch fn's in_spec; _put keeps this correct on
+        # multi-host meshes (each host supplies its own client columns of
+        # the deterministic permutation)
+        sh = NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS))
+        return self._put(idx.astype(np.int32), sh)
+
+    def _fetch(self, x) -> np.ndarray:
+        """Device -> host, multi-host-safe.
+
+        np.asarray on an array spanning non-addressable devices raises;
+        with >1 process the shards are all-gathered so every host sees
+        the global value (outputs here are small: losses, counts, flat)."""
+        if jax.process_count() == 1:
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
     def evaluate(self) -> np.ndarray:
         """Per-client top-1 accuracy over the full test set."""
@@ -269,8 +302,8 @@ class Trainer:
             self.mean,
             self.std,
         )
-        total = int(np.asarray(self.test_mask).sum())
-        return np.asarray(correct) / total
+        total = int(np.asarray(self.test_mask).sum())  # replicated: local
+        return self._fetch(correct) / total
 
     def _check_losses(self, losses: np.ndarray, **ctx) -> None:
         """Per-epoch failure detection: a client whose losses went
@@ -290,7 +323,7 @@ class Trainer:
             self._health_fn = jax.jit(
                 lambda f: jnp.isfinite(f).all(axis=tuple(range(1, f.ndim)))
             )
-        ok = np.asarray(self._health_fn(self.flat))
+        ok = self._fetch(self._health_fn(self.flat))
         bad = np.where(~ok)[0]
         if bad.size:
             self.recorder.fault("nonfinite_params", bad, **ctx)
@@ -330,7 +363,7 @@ class Trainer:
                         z,
                         rho,
                     )
-                    losses = np.asarray(losses)  # [S, K] (blocks on device)
+                    losses = self._fetch(losses)  # [S, K] (blocks on device)
                 self.recorder.step_time(
                     "epoch",
                     time.perf_counter() - t0,
@@ -366,7 +399,7 @@ class Trainer:
                     self.flat, y, z, rho, extra, met = consensus_fn(
                         self.flat, y, z, rho, extra, jnp.int32(nadmm)
                     )
-                    dual, primal, mean_rho = (np.asarray(m) for m in met)
+                    dual, primal, mean_rho = (self._fetch(m) for m in met)
                 self.recorder.step_time(
                     "consensus",
                     time.perf_counter() - t0,
@@ -422,26 +455,28 @@ class Trainer:
 
     def save(self, step: int) -> str:
         state = {
-            "flat": self.flat,
-            "batch_stats": self.stats,
+            "flat": self._fetch(self.flat),
+            "batch_stats": jax.tree.map(self._fetch, self.stats),
             "completed_nloops": np.int64(self._completed_nloops),
             # rho is the ONE piece of consensus state that outlives a
             # round (see _rho_store); keyed by group id as strings for
             # the checkpoint tree
-            "rho_store": {str(g): r for g, r in self._rho_store.items()},
+            "rho_store": {
+                str(g): self._fetch(r) for g, r in self._rho_store.items()
+            },
         }
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
     def _restore(self) -> None:
         state = load_checkpoint(self.cfg.checkpoint_dir)
         csh = client_sharding(self.mesh)
-        self.flat = jax.device_put(jnp.asarray(state["flat"]), csh)
+        self.flat = self._put(state["flat"], csh)
         self.stats = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), csh), state["batch_stats"]
+            lambda x: self._put(x, csh), state["batch_stats"]
         )
         self._completed_nloops = int(state["completed_nloops"])
         for g, r in state.get("rho_store", {}).items():
-            self._rho_store[int(g)] = jax.device_put(jnp.asarray(r), csh)
+            self._rho_store[int(g)] = self._put(r, csh)
 
 
 def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> MetricsRecorder:
