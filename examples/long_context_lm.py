@@ -24,6 +24,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# honor an explicit CPU request: the ambient environment may pin jax to a
+# TPU PJRT plugin that overrides JAX_PLATFORMS (see utils/hostcpu.py)
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    from federated_pytorch_test_tpu.utils import force_host_cpu
+
+    force_host_cpu()
+
 import jax
 import jax.numpy as jnp
 import optax

@@ -1,0 +1,81 @@
+"""Worker for the REAL multi-process test (tests/test_multiprocess.py).
+
+Each OS process claims 4 virtual CPU devices and joins a 2-process JAX
+distributed runtime: 8 global devices, one `clients` mesh spanning BOTH
+processes. The FedAvg round then exercises the cross-process paths the
+in-process suite cannot: `_put` via `make_array_from_callback` (each
+process supplies its own client shards), the consensus `psum` across the
+process boundary, and `_fetch` via `process_allgather`.
+
+Invoked as:
+    python tests/multiprocess_worker.py <process_id> <num_processes> <port>
+
+Prints one line `RESULT <json>` with round metrics; the parent asserts
+both processes agree and match the single-process run bit-for-bit.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    )
+    from federated_pytorch_test_tpu.utils import force_host_cpu
+
+    jax = force_host_cpu()
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        cluster_detection_method="deactivate",
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 4 * nproc
+
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+    from federated_pytorch_test_tpu.parallel import multihost_client_mesh
+
+    k = 4 * nproc
+    src = synthetic_cifar(n_train=8 * k, n_test=2 * k)
+    cfg = get_preset(
+        "fedavg", model="net", n_clients=k, batch=4, nloop=1, nadmm=1,
+        check_results=False,
+    )
+    mesh = multihost_client_mesh(k)
+    tr = Trainer(cfg, verbose=False, source=src, mesh=mesh)
+    gid = tr.group_order[0]
+    tr.run_round(nloop=0, gid=gid)
+
+    flat = tr._fetch(tr.flat)
+    accs = tr.evaluate()
+    # the active group's coords must agree across ALL K clients (the
+    # consensus broadcast crossed the process boundary)
+    sync_err = 0.0
+    for seg in tr.partition.groups[gid]:
+        blk = flat[:, seg.start : seg.start + seg.size]
+        sync_err = max(sync_err, float(np.abs(blk - blk[:1]).max()))
+
+    out = {
+        "process": pid,
+        "gid": int(gid),
+        "sync_err": sync_err,
+        "flat_sum": float(np.float64(flat.sum())),
+        "accs": [float(a) for a in accs],
+        "dual": float(tr.recorder.latest("dual_residual")),
+    }
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

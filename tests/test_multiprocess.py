@@ -1,0 +1,104 @@
+"""REAL multi-process distributed test: 2 OS processes, one client mesh.
+
+The rest of the suite simulates the cluster with 8 virtual devices in ONE
+process; here two separate processes (4 virtual CPU devices each) join a
+JAX distributed runtime and run a FedAvg round on an 8-client mesh that
+spans the process boundary — the closest this CI can get to multi-host
+TPU (the process boundary stands in for DCN). Asserts:
+
+* both processes finish and report IDENTICAL metrics (the SPMD contract);
+* the consensus broadcast synchronized the active group across all 8
+  clients, i.e. the weighted-psum collective crossed processes;
+* the run matches the SAME workload on a single-process 8-device mesh
+  (the multi-process data/placement paths change nothing numerically).
+
+Slow (two interpreters, distributed init, fresh compiles): ~3-4 min on
+the 1-core CI box.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "multiprocess_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_workers(nproc: int, timeout: float = 480.0):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(nproc), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+        assert all(p.returncode == 0 for p in procs), (
+            "\n\n".join(o[-3000:] for o in outs)
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lines, out[-3000:]
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+    return results
+
+
+def test_two_process_fedavg_round_matches_single_process():
+    r0, r1 = _run_workers(2)
+
+    # SPMD: every process computed the same global story
+    assert r0["gid"] == r1["gid"]
+    np.testing.assert_allclose(r0["flat_sum"], r1["flat_sum"], rtol=0)
+    np.testing.assert_allclose(r0["accs"], r1["accs"], rtol=0)
+    np.testing.assert_allclose(r0["dual"], r1["dual"], rtol=0)
+    # consensus crossed the process boundary: active group bit-identical
+    # across all 8 clients
+    assert r0["sync_err"] == 0.0
+
+    # and the whole thing equals the single-process 8-device run
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("need 8 devices for the single-process twin")
+    k = 8
+    src = synthetic_cifar(n_train=8 * k, n_test=2 * k)
+    cfg = get_preset(
+        "fedavg", model="net", n_clients=k, batch=4, nloop=1, nadmm=1,
+        check_results=False,
+    )
+    tr = Trainer(cfg, verbose=False, source=src)
+    gid = tr.group_order[0]
+    tr.run_round(nloop=0, gid=gid)
+    flat_sum = float(np.float64(np.asarray(tr._fetch(tr.flat)).sum()))
+    accs = [float(a) for a in tr.evaluate()]
+
+    assert gid == r0["gid"]
+    np.testing.assert_allclose(flat_sum, r0["flat_sum"], rtol=1e-6)
+    np.testing.assert_allclose(accs, r0["accs"], rtol=0)
