@@ -42,6 +42,7 @@ from federated_pytorch_test_tpu.parallel import SEQ_AXIS
 from federated_pytorch_test_tpu.partition import flatten_params
 
 SEQ = int(os.environ.get("SEQ", "512"))
+STEPS = int(os.environ.get("STEPS", "12"))
 VOCAB = 64
 ATTN_IMPL = os.environ.get("ATTN_IMPL", "ring")  # 'ring' | 'ring_flash'
 
@@ -113,9 +114,9 @@ def main():
     step = jax.jit(lambda f, s: lbfgs_step(loss_fn, f, s, cfg))
 
     print(f"loss[0] = {float(loss_fn(flat)):.4f}")
-    for i in range(12):
+    for i in range(STEPS):
         flat, state, aux = step(flat, state)
-    print(f"loss[12] = {float(loss_fn(flat)):.4f}  "
+    print(f"loss[{STEPS}] = {float(loss_fn(flat)):.4f}  "
           f"(func_evals={int(state.func_evals)})")
 
 
