@@ -57,4 +57,24 @@ with jax.default_matmul_precision("highest"):
     o, lse = jax.jit(lambda q,k,v: flash_block(q[:, :128], k[:, 128:], v[:, 128:],
                       jnp.int32(0), jnp.int32(128), causal=True))(q,k,v)
     assert float(jnp.abs(o).max()) == 0.0 and float(lse.max()) <= -1e29
+# --- compact L-BFGS direction kernels (ops/compact_pallas.py) vs the
+# pure-JAX compact backend (optim/compact.py) on the chip ---
+from federated_pytorch_test_tpu.ops.compact_pallas import compact_direction_pallas
+from federated_pytorch_test_tpu.optim.compact import compact_direction
+
+m, n = 10, 1_000_003  # odd N exercises the masked tail tile
+s_hist = jnp.asarray(rng.randn(m, n) * 1e-2, jnp.float32)
+y_hist = jnp.asarray(rng.randn(m, n) * 1e-2, jnp.float32)
+g = jnp.asarray(rng.randn(n), jnp.float32)
+for count in (0, 4, 10):
+    cnt = jnp.int32(count)
+    hd = jnp.float32(0.7)
+    d_pl = jax.jit(compact_direction_pallas)(g, s_hist, y_hist, cnt, hd)
+    with jax.default_matmul_precision("highest"):
+        d_ref = jax.jit(compact_direction)(g, s_hist, y_hist, cnt, hd)
+    scale = float(jnp.abs(d_ref).max())
+    err = float(jnp.abs(d_pl - d_ref).max()) / max(scale, 1e-30)
+    print(f"compact direction count={count}: rel err {err:.2e}")
+    assert err < 5e-5, err
+print("COMPACT-ON-TPU OK")
 print("NEW-FLASH-ON-TPU OK")

@@ -88,10 +88,12 @@ def _gram_kernel(
 
     contract = (((1,), (1,)), ((), ()))
     sy_ref[:] += jax.lax.dot_general(
-        s, y, contract, preferred_element_type=jnp.float32
+        s, y, contract, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
     )
     yy_ref[:] += jax.lax.dot_general(
-        y, y, contract, preferred_element_type=jnp.float32
+        y, y, contract, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
     )
     p_ref[:] += jnp.sum(s * g, axis=1, keepdims=True)
     q_ref[:] += jnp.sum(y * g, axis=1, keepdims=True)
@@ -149,10 +151,12 @@ def _assembly_kernel(
     hd = hd_ref[0, 0]
     contract = (((1,), (0,)), ((), ()))  # [1, m] @ [m, T]
     ws = jax.lax.dot_general(
-        w_ref[:].T, s, contract, preferred_element_type=jnp.float32
+        w_ref[:].T, s, contract, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
     )
     uy = jax.lax.dot_general(
-        u_ref[:].T, y, contract, preferred_element_type=jnp.float32
+        u_ref[:].T, y, contract, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
     )
     out_ref[:] = hd * g + ws - hd * uy
 
