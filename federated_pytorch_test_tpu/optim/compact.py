@@ -34,7 +34,13 @@ from __future__ import annotations
 from typing import Callable, Tuple
 
 import jax.numpy as jnp
+from jax.lax import Precision
 from jax.scipy.linalg import solve_triangular
+
+# full-f32 MXU passes for the heavy [m, N] contractions: they are HBM-
+# bandwidth-bound, so this costs nothing and matches the Pallas backend's
+# fidelity instead of drifting with single-bf16-pass MXU defaults on TPU
+_HI = Precision.HIGHEST
 
 
 def compact_solves(
@@ -103,18 +109,18 @@ def compact_direction(
     y = jnp.where(valid[:, None], y_hist, 0.0)
 
     # the heavy contractions: [m,N] @ [N,m] / [m,N] @ [N] passes (MXU)
-    sy = s @ y.T  # sy[i, j] = s_i . y_j
-    p = s @ g  # Sᵀg  [m]
-    q = y @ g  # Yᵀg  [m]
+    sy = jnp.matmul(s, y.T, precision=_HI)  # sy[i, j] = s_i . y_j
+    p = jnp.matmul(s, g, precision=_HI)  # Sᵀg  [m]
+    q = jnp.matmul(y, g, precision=_HI)  # Yᵀg  [m]
 
     def yyu(u):
         # (YᵀY)u contracted as Y(uᵀY): (yy @ u)[i] = y_i · Σ_j u_j y_j =
         # (y @ uy)[i]; avoids an [m,N]@[N,m] Gram pass and `uy` is reused
         # in the final assembly
-        uy = u @ y  # [N]
-        return y @ uy, uy
+        uy = jnp.matmul(u, y, precision=_HI)  # [N]
+        return jnp.matmul(y, uy, precision=_HI), uy
 
     u, w, _, uy = compact_solves(sy, p, q, valid, h_diag, yyu)
 
-    hg = h_diag * g + w @ s - h_diag * uy
+    hg = h_diag * g + jnp.matmul(w, s, precision=_HI) - h_diag * uy
     return -hg
