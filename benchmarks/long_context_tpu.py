@@ -44,23 +44,29 @@ LENGTHS = (1024, 2048, 4096, 8192, 16384)
 DENSE_MAX = 8192  # [2, 8, 16384^2] f32 scores = 17 GiB/copy: past HBM
 
 
-def timed(fn, qs, ks, vs, reps):
-    """Mean step time over `reps` calls on distinct resident inputs.
+def timed(fn, qs, ks, vs, reps, inner):
+    """Best-of-`reps` PER-STEP time over distinct resident inputs.
 
-    Input set 0 is burned on compile+warmup; sets 1..reps are timed, so
-    no timed call repeats an (executable, args) pair the runtime has
-    already seen."""
-    float(fn(qs[0], ks[0], vs[0])[0])
-    t0 = time.perf_counter()
-    losses = [fn(qs[i], ks[i], vs[i])[0] for i in range(1, reps + 1)]
-    float(jnp.stack(losses).sum())  # forces every rep; fetches 4 bytes
-    return (time.perf_counter() - t0) / reps
+    Each call runs `inner` fwd+bwd steps INSIDE the jitted function (a
+    fori_loop perturbing q per iteration): the remote-tunnel dispatch
+    latency (~0.1 s/call, flat in S — it used to swamp every row of this
+    table) is paid once per call and amortized away by the division.
+    Input set 0 is burned on compile+warmup; sets 1..reps are each timed
+    individually and the MINIMUM is reported (as bench.py does): on the
+    shared chip a single contended rep would otherwise poison a mean."""
+    float(fn(qs[0], ks[0], vs[0]))
+    best = float("inf")
+    for i in range(1, reps + 1):
+        t0 = time.perf_counter()
+        float(fn(qs[i], ks[i], vs[i]))  # forces the call; fetches 4 bytes
+        best = min(best, time.perf_counter() - t0)
+    return best / inner
 
 
 def main():
     assert jax.default_backend() == "tpu", jax.default_backend()
     rng = np.random.RandomState(0)
-    reps = 2
+    reps = 3
     # burn the tunnel's first-dispatch overhead on a throwaway call
     w = jnp.ones((1, 128, 1, 64), jnp.float32)
     float(flash_attention(w, w, w, causal=True).sum())
@@ -75,6 +81,10 @@ def main():
         )
         float(sum(x[0, 0, 0, 0] for x in qs + ks + vs))
 
+        # inner fwd+bwd steps per jitted call: enough that real kernel
+        # time dominates the flat ~0.1 s dispatch latency at every S
+        inner = max(4, (8192 * 8192) // (s * s) * 4)
+
         def make(attn, prec):
             def step(q, k, v):
                 def loss(q, k, v):
@@ -82,22 +92,32 @@ def main():
                         out = attn(q, k, v, causal=True)
                     return jnp.sum(out ** 2)
 
-                l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-                return l, grads
+                def body(i, acc):
+                    # perturb q so no iteration repeats the last one's
+                    # inputs; full-reduce every grad so none is dead code
+                    qi = q * (1.0 + i.astype(jnp.float32) * 1e-6)
+                    l, gs = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                        qi, k, v
+                    )
+                    return acc + l + sum(jnp.sum(g) for g in gs)
+
+                return jax.lax.fori_loop(0, inner, body, jnp.float32(0))
 
             return jax.jit(step)
 
-        row = {"seq_len": s}
+        row = {"seq_len": s, "inner_steps": inner}
         for prec in ("default", "highest"):
             flash = lambda q, k, v, causal: flash_attention(
                 q, k, v, causal=causal, precision=prec
             )
-            t_flash = timed(make(flash, prec), qs, ks, vs, reps)
-            row[f"flash_{prec}_step_s"] = round(t_flash, 4)
+            t_flash = timed(make(flash, prec), qs, ks, vs, reps, inner)
+            row[f"flash_{prec}_step_s"] = round(t_flash, 5)
             row[f"flash_{prec}_tokens_per_s"] = round(B * s / t_flash)
             if s <= DENSE_MAX:
-                t_dense = timed(make(dense_attention, prec), qs, ks, vs, reps)
-                row[f"dense_{prec}_step_s"] = round(t_dense, 4)
+                t_dense = timed(
+                    make(dense_attention, prec), qs, ks, vs, reps, inner
+                )
+                row[f"dense_{prec}_step_s"] = round(t_dense, 5)
                 row[f"speedup_{prec}"] = round(t_dense / t_flash, 2)
             else:
                 row[f"dense_{prec}_step_s"] = None  # scores exceed HBM

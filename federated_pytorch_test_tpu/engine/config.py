@@ -28,12 +28,17 @@ class ExperimentConfig:
 
     name: str = "custom"
     model: str = "net"  # net | net1 | net2 | resnet18 | vit (models.MODELS)
-    # 'bfloat16' runs convs/matmuls in bf16 on the MXU (params, norms,
-    # the loss, and ALL L-BFGS math stay f32 — mixed precision, not low
-    # precision). 'float32' matches the reference bit-for-bit in spirit.
-    # Measured on one real chip: bf16 LOSES ~1.6x on ResNet18 @ batch 32
-    # (the f32-norm cast boundaries outweigh MXU gains at this size), so
-    # f32 stays the default; the knob matters for larger models/batches.
+    # 'bfloat16' runs convs/matmuls AND norm elementwise math in bf16
+    # (params, the loss, and ALL L-BFGS math stay f32 — mixed precision,
+    # not low precision). 'float32' matches the reference bit-for-bit in
+    # spirit — and note that XLA's default matmul precision already runs
+    # f32 convs as single bf16 MXU passes, so on CIFAR-sized workloads
+    # f32 keeps bf16's compute speed without its cast seams. Round-2
+    # profiling (BASELINE.md roofline note) recovered bf16 from 2.1x to
+    # ~1.3x slower on the batch-32 flagship (hoisted closure cast,
+    # fusable bf16 BN reductions); f32 stays the default — the knob pays
+    # off where activation memory is the binding constraint (long-context
+    # transformers, large batches with remat), not small CNNs.
     compute_dtype: str = "float32"
     # rematerialize the forward during backprop (jax.checkpoint): trades
     # ~1/3 more FLOPs for activation memory — the lever for batch sizes /
@@ -107,6 +112,13 @@ class ExperimentConfig:
     load_model: bool = False
     save_model: bool = False
     check_results: bool = True  # eval after each averaging round
+    # with `check_results`, ALSO evaluate after every minibatch — the
+    # reference's exact telemetry cadence for check_results=True
+    # (reference src/no_consensus_trio.py:266-267, every `opt.step`).
+    # The epoch then runs one jitted minibatch at a time so the jitted
+    # eval sweep can interleave; per-epoch cadence stays the default
+    # because it keeps the whole epoch one device computation.
+    eval_every_batch: bool = False
     average_model: bool = False  # one-shot whole-model mean at start
     #   (reference src/no_consensus_trio.py:22,134-160)
 

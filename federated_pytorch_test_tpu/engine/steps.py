@@ -49,11 +49,35 @@ from federated_pytorch_test_tpu.consensus import (
     fedavg_round,
 )
 from federated_pytorch_test_tpu.data import normalize
-from federated_pytorch_test_tpu.optim import LBFGSConfig, lbfgs_init, lbfgs_step
+from federated_pytorch_test_tpu.optim import (
+    LBFGSConfig,
+    lbfgs_init,
+    lbfgs_step,
+    vma_zero,
+)
 from federated_pytorch_test_tpu.parallel import CLIENT_AXIS
 from federated_pytorch_test_tpu.partition import Partition
 
 PyTree = Any
+
+
+def _check_vma(ctx: Optional["GroupContext"] = None) -> bool:
+    """Whether shard_map's varying-axis checking can stay ON.
+
+    Off only when a Pallas kernel would run in INTERPRET mode inside the
+    mapped function (the interpreter cannot propagate varying-mesh-axis
+    metadata through its internal slicing); compiled TPU kernels carry the
+    vma via their out_shape annotation, so the real-chip path keeps JAX's
+    sharding checks enabled.
+
+    The engine's ONLY Pallas path today is the L-BFGS 'pallas' direction
+    backend, so that is all this detects. If model-level Pallas ever
+    becomes reachable through the engine registry (e.g. an `attn_impl`
+    config knob routing flash attention into the epoch/eval fns), extend
+    this check — and build_eval_fn's hard-coded True — to cover it.
+    """
+    uses_pallas = ctx is not None and ctx.lbfgs.direction == "pallas"
+    return not (uses_pallas and jax.default_backend() != "tpu")
 
 
 class GroupContext(NamedTuple):
@@ -123,13 +147,34 @@ def _client_train_step(ctx: GroupContext):
     (reference src/federated_trio.py:304-352), as a pure function.
     """
 
+    # compute dtype of the model's matmuls/convs; when it is narrower
+    # than f32 the FULL parameter vector is cast ONCE per minibatch here
+    # instead of once per closure evaluation inside the line search —
+    # measured on a v5e, the per-eval casts (62 leaves x ~9 evals/step)
+    # were most of bfloat16 mode's overhead, not the MXU work
+    model_dt = getattr(ctx.model, "dtype", jnp.float32)
+    hoist_cast = model_dt != jnp.float32
+
     def step(flat, lstate, stats, images_u8, labels, mean, std, y, z, rho):
         images = normalize(images_u8, mean, std)
+        base = flat.astype(model_dt) if hoist_cast else flat
 
         def loss_fn(x):
-            full = ctx.partition.insert(flat, ctx.gid, x)
+            # substituting the active group into the PRE-CAST remainder is
+            # numerically identical to casting inside: the frozen
+            # coordinates round f32->bf16 the same either way, and x's
+            # own cast keeps the gradient path to f32 x
+            xc = x.astype(model_dt) if hoist_cast else x
+            full = ctx.partition.insert(base, ctx.gid, xc)
             loss, _ = _data_loss(ctx, full, stats, images, labels)
-            loss = loss + _regularizer(ctx, x, full)
+            if ctx.reg_segments and hoist_cast:
+                # fixed-segment elastic net reads FROZEN coordinates of
+                # the full vector: keep that in f32 (the segments don't
+                # change within the step, so this inserts into f32 flat)
+                full_reg = ctx.partition.insert(flat, ctx.gid, x)
+            else:
+                full_reg = full
+            loss = loss + _regularizer(ctx, x, full_reg)
             if ctx.strategy == "admm":
                 loss = loss + admm_penalty(x, y, z, rho)
             return loss
@@ -189,7 +234,7 @@ def build_epoch_fn(ctx: GroupContext, mesh):
         mesh=mesh,
         in_specs=(c, c, c, c, c, P(None, CLIENT_AXIS), c, c, c, r, c),
         out_specs=(c, c, c, P(None, CLIENT_AXIS)),
-        check_vma=False,
+        check_vma=_check_vma(ctx),
     )
     # params/opt-state/batch-stats are consumed and re-emitted every epoch:
     # donate them so XLA updates in place instead of double-buffering
@@ -225,7 +270,7 @@ def build_round_init_fn(ctx: GroupContext, mesh):
         mesh=mesh,
         in_specs=(c,),
         out_specs=(c, c, P(), c, (c, c)),
-        check_vma=False,
+        check_vma=True,
     )
     return jax.jit(sharded)
 
@@ -278,7 +323,7 @@ def build_consensus_fn(ctx: GroupContext, mesh):
         mesh=mesh,
         in_specs=(c, c, r, c, (c, c), r),
         out_specs=(c, c, r, c, (c, c), (r, r, r)),
-        check_vma=False,
+        check_vma=True,
     )
     # no donation here: the round-init placeholders alias buffers (e.g.
     # the fedavg extra=(y, y)) and these arrays are one group wide anyway
@@ -306,8 +351,12 @@ def build_eval_fn(model, unravel, has_stats: bool, mesh):
             pred = jnp.argmax(logits, axis=-1)
             return correct + jnp.sum((pred == lab) & msk), None
 
+        # seed the scan carry with the client axis's varying type —
+        # required by vma checking, numerically an exact zero
         correct, _ = lax.scan(
-            body, jnp.int32(0), (test_imgs, test_labels, test_mask)
+            body,
+            jnp.int32(0) + vma_zero(mean).astype(jnp.int32),
+            (test_imgs, test_labels, test_mask),
         )
         return correct
 
@@ -325,6 +374,6 @@ def build_eval_fn(model, unravel, has_stats: bool, mesh):
         mesh=mesh,
         in_specs=(c, c, r, r, r, c, c),
         out_specs=c,
-        check_vma=False,
+        check_vma=True,
     )
     return jax.jit(sharded)

@@ -172,13 +172,23 @@ class Trainer:
         if cfg.average_model:
             # one-shot whole-model average before training
             # (reference src/no_consensus_trio.py:22,134-160)
-            host_flat = self._fetch(self.flat)
-            self.flat = self._put(
-                np.broadcast_to(
-                    host_flat.mean(axis=0), host_flat.shape
-                ).copy(),
-                csh,
-            )
+            if jax.process_count() == 1:
+                # device-side mean: keeps the f32 reduction order (and so
+                # the resulting trajectory) bit-identical to prior runs
+                self.flat = self._put(
+                    jnp.broadcast_to(
+                        jnp.mean(self.flat, axis=0), self.flat.shape
+                    ),
+                    csh,
+                )
+            else:
+                host_flat = self._fetch(self.flat)
+                self.flat = self._put(
+                    np.broadcast_to(
+                        host_flat.mean(axis=0), host_flat.shape
+                    ).copy(),
+                    csh,
+                )
 
     # ---------------------------------------------------------------- setup
 
@@ -346,24 +356,57 @@ class Trainer:
             for epoch in range(cfg.nepoch):
                 idx = self._epoch_indices(nloop, gid, nadmm, epoch)
                 self._step_num += 1
+                per_batch_eval = cfg.check_results and cfg.eval_every_batch
                 t0 = time.perf_counter()
                 with jax.profiler.StepTraceAnnotation(
                     "epoch", step_num=self._step_num
                 ):
-                    self.flat, lstate, self.stats, losses = epoch_fn(
-                        self.flat,
-                        lstate,
-                        self.stats,
-                        self.shard_imgs,
-                        self.shard_labels,
-                        idx,
-                        self.mean,
-                        self.std,
-                        y,
-                        z,
-                        rho,
-                    )
-                    losses = self._fetch(losses)  # [S, K] (blocks on device)
+                    if per_batch_eval:
+                        # reference check_results=True telemetry: evaluate
+                        # after EVERY optimizer step (reference
+                        # src/no_consensus_trio.py:266-267) — the epoch
+                        # runs one jitted minibatch at a time so the
+                        # jitted eval sweep interleaves
+                        rows = []
+                        for s in range(idx.shape[0]):
+                            (self.flat, lstate, self.stats, l_s) = epoch_fn(
+                                self.flat,
+                                lstate,
+                                self.stats,
+                                self.shard_imgs,
+                                self.shard_labels,
+                                idx[s : s + 1],
+                                self.mean,
+                                self.std,
+                                y,
+                                z,
+                                rho,
+                            )
+                            rows.append(self._fetch(l_s)[0])
+                            self.recorder.accuracies(
+                                self.evaluate(),
+                                nloop=nloop,
+                                group=gid,
+                                nadmm=nadmm,
+                                epoch=epoch,
+                                minibatch=s,
+                            )
+                        losses = np.stack(rows)  # [S, K]
+                    else:
+                        self.flat, lstate, self.stats, losses = epoch_fn(
+                            self.flat,
+                            lstate,
+                            self.stats,
+                            self.shard_imgs,
+                            self.shard_labels,
+                            idx,
+                            self.mean,
+                            self.std,
+                            y,
+                            z,
+                            rho,
+                        )
+                        losses = self._fetch(losses)  # [S, K]
                 self.recorder.step_time(
                     "epoch",
                     time.perf_counter() - t0,
@@ -385,11 +428,16 @@ class Trainer:
                     self._check_losses(
                         losses, nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch
                     )
-                if cfg.strategy == "none" and cfg.check_results:
+                if (
+                    cfg.strategy == "none"
+                    and cfg.check_results
+                    and not per_batch_eval  # already recorded per batch
+                ):
                     # independent training has no consensus round; eval per
                     # epoch (the reference evals per batch,
-                    # src/no_consensus_trio.py:266-267 — per-epoch is the
-                    # tractable equivalent cadence)
+                    # src/no_consensus_trio.py:266-267 — `eval_every_batch`
+                    # reproduces that cadence exactly; per-epoch is the
+                    # default because it keeps the epoch one computation)
                     self.recorder.accuracies(
                         self.evaluate(), nloop=nloop, group=gid, nadmm=epoch
                     )
@@ -419,7 +467,12 @@ class Trainer:
                 )
             if check:
                 self._check_params(nloop=nloop, group=gid, nadmm=nadmm)
-            if cfg.check_results:
+            if cfg.check_results and not (
+                cfg.eval_every_batch and cfg.strategy == "none"
+                # params unchanged since the last per-batch eval (no
+                # consensus step ran): the round-end record would be a
+                # duplicate of it
+            ):
                 self.recorder.accuracies(
                     self.evaluate(), nloop=nloop, group=gid, nadmm=nadmm
                 )

@@ -47,15 +47,29 @@ def _conv(features: int, kernel: int, stride: int, name: str, dtype=None) -> nn.
     )
 
 
-def _bn(name: str, train: bool) -> nn.BatchNorm:
-    # normalization always runs in f32 (mixed-precision recipe: cheap
-    # elementwise math in full precision, matmuls/convs in compute dtype)
+def _bn(name: str, train: bool, dtype=None) -> nn.BatchNorm:
+    # the WHOLE layer — including the mean/var reductions — follows the
+    # model compute dtype. Profiled on a v5e (see BASELINE.md roofline
+    # note): flax's default force_float32_reductions emitted an unfusable
+    # convert+reduce pair per BN per closure evaluation that was 42% of
+    # the bfloat16 epoch (f32-pinned BN, the round-1 design, was worse
+    # still — two HBM casts per conv->BN->conv seam). bf16 statistics
+    # over CIFAR batch*H*W samples agree with f32 to ~1e-2 relative —
+    # convergence-checked against the f32 path in tests/test_engine.py.
+    # Running stats still live in f32 (param_dtype default).
+    low_prec = dtype is not None and dtype != jnp.float32
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-5,
         name=name,
-        dtype=jnp.float32,
+        dtype=dtype,
+        # f32 keeps flax defaults exactly; low precision trades them for
+        # fusable reductions + the cancellation-safe two-pass variance
+        # (E[(x-mean)^2] — E[x^2]-E[x]^2 in bf16 measured no faster and
+        # loses digits to cancellation)
+        force_float32_reductions=not low_prec,
+        use_fast_variance=not low_prec,
     )
 
 
@@ -73,10 +87,10 @@ class BasicBlock(nn.Module):
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         in_planes = x.shape[-1]
         dt = self.dtype
-        out = nn.elu(_bn("bn1", train)(_conv(self.planes, 3, self.stride, "conv1", dt)(x)))
-        out = _bn("bn2", train)(_conv(self.planes, 3, 1, "conv2", dt)(out))
+        out = nn.elu(_bn("bn1", train, dt)(_conv(self.planes, 3, self.stride, "conv1", dt)(x)))
+        out = _bn("bn2", train, dt)(_conv(self.planes, 3, 1, "conv2", dt)(out))
         if self.stride != 1 or in_planes != self.planes:
-            x = _bn("sc_bn", train)(_conv(self.planes, 1, self.stride, "sc_conv", dt)(x))
+            x = _bn("sc_bn", train, dt)(_conv(self.planes, 1, self.stride, "sc_conv", dt)(x))
         return nn.elu(out + x.astype(out.dtype))
 
 
@@ -120,7 +134,7 @@ class ResNet18(PartitionedModel):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = nn.elu(_bn("bn1", train)(_conv(64, 3, 1, "conv1", self.dtype)(x)))
+        x = nn.elu(_bn("bn1", train, self.dtype)(_conv(64, 3, 1, "conv1", self.dtype)(x)))
         for i, (planes, stride) in enumerate(self.STAGES):
             x = BasicBlock(
                 planes=planes, stride=stride, dtype=self.dtype, name=f"block{i}"

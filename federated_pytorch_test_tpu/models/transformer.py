@@ -115,7 +115,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x)
+        y = nn.LayerNorm(name="ln1", dtype=self.dtype)(x)
         x = x + MultiHeadAttention(
             self.dim,
             self.num_heads,
@@ -125,7 +125,7 @@ class Block(nn.Module):
             dtype=self.dtype,
             name="attn",
         )(y)
-        y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
+        y = nn.LayerNorm(name="ln2", dtype=self.dtype)(x)
         y = nn.Dense(
             self.mlp_ratio * self.dim,
             name="fc1",
@@ -218,7 +218,7 @@ class TransformerLM(PartitionedModel):
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x)
-        x = nn.LayerNorm(name="ln_out", dtype=jnp.float32)(x)
+        x = nn.LayerNorm(name="ln_out", dtype=self.dtype)(x)
         return nn.Dense(
             self.vocab, name="head", kernel_init=kernel_init,
             bias_init=bias_init, dtype=self.dtype,
@@ -282,7 +282,7 @@ class ViT(PartitionedModel):
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x)
-        x = nn.LayerNorm(name="ln_out", dtype=jnp.float32)(x)
+        x = nn.LayerNorm(name="ln_out", dtype=self.dtype)(x)
         x = jnp.mean(x, axis=1)  # mean-pool tokens
         return nn.Dense(
             self.num_classes, name="head", kernel_init=kernel_init,

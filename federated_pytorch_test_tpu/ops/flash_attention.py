@@ -20,8 +20,23 @@ operand (KV for fwd/dq, Q/dO for dk/dv) enters one `[128, D]` tile per
 grid step through its BlockSpec while accumulators live in VMEM scratch,
 initialized on the first streamed step and flushed to the revisited
 output block on the last. Sequence length is therefore HBM-bound, not
-VMEM-bound. Causal skipping is `@pl.when` predication on the streamed
-index (the tile DMA still happens; the compute does not).
+VMEM-bound.
+
+Causal iteration comes in two shapes:
+
+* ALIGNED (the single-device `flash_attention` path, offsets == 0,
+  s_q == s_kv): the grid itself is TRIANGULAR — a `(batch*head, npairs)`
+  grid over exactly the lower-triangular (Q block, KV block) pairs,
+  driven by scalar-prefetched (i, j) lookup tables that the BlockSpec
+  index maps read. Skipped tiles do not exist: no grid step, no DMA, no
+  compute is spent above the diagonal, so causal runs the ~S²/2 work a
+  causal kernel should, not predicated-S².
+* OFFSET (`flash_block` under ring attention, device-varying traced
+  offsets): the rectangular grid stays (the useful-pair count is not
+  static), with `@pl.when` predication plus index-map CLAMPING onto the
+  last useful block — a repeated block index makes the tile DMA a no-op,
+  so skipped steps still cost neither bandwidth nor MXU compute, only
+  grid-step overhead.
 
 Global-position offsets: every kernel takes an int32 `[q_off, k_off]`
 scalar-prefetch operand placing this call's Q and K/V blocks on the
@@ -63,14 +78,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
 
-# Tile heights. 128 matches the MXU systolic edge; S must be a multiple
-# (the LM/ViT sequence lengths are powers of two — assert, don't silently
-# pad, so callers see the constraint).
-_BQ = 128
-_BK = 128
-# the causal skip/elision formulas assume equal tile heights; retuning
-# one constant requires reinstating block-ratio bounds
-assert _BQ == _BK
+# Default tile heights; S must be a multiple of the resolved tile (the
+# LM/ViT sequence lengths are powers of two — raise, don't silently pad,
+# so callers see the constraint). 128 is the MXU systolic edge and the
+# floor; at D=64 a 128-row tile leaves every grid step overhead-dominated
+# (~1 us/step vs ~20 ns of MXU work), so the defaults are larger — see
+# benchmarks/long_context_tpu.json for the measured sweep on a v5e.
+# Both public entries take block_q/block_k overrides.
+_BQ = 512
+_BK = 512
 
 _HI = jax.lax.Precision.HIGHEST
 
@@ -99,8 +115,8 @@ def _causal_mask(sc, qpos0, kpos0):
     `qpos0`/`kpos0` are the global positions of the tile's first row/col
     (offset + block index * tile height); they may be traced scalars.
     """
-    qpos = qpos0 + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 0)
-    kpos = kpos0 + jax.lax.broadcasted_iota(jnp.int32, (_BQ, _BK), 1)
+    qpos = qpos0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+    kpos = kpos0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
     return jnp.where(kpos <= qpos, sc, _NEG_BIG)
 
 
@@ -139,29 +155,146 @@ def _run_unless_skipped(causal, keep_pred, compute):
 # ---------------------------------------------------------------------------
 
 
-def _kv_keep(off, i, j):
-    return off[1] + j * _BK <= off[0] + (i + 1) * _BQ - 1
+def _kv_keep(off, i, j, bq, bk):
+    return off[1] + j * bk <= off[0] + (i + 1) * bq - 1
 
 
-def _kv_clamp(off, i, j, nkv):
+def _kv_clamp(off, i, j, nkv, bq, bk):
     # last useful kv block for q block i (may be <0: whole row masked)
-    jmax = (off[0] + (i + 1) * _BQ - 1 - off[1]) // _BK
+    jmax = (off[0] + (i + 1) * bq - 1 - off[1]) // bk
     return jnp.clip(jnp.minimum(j, jmax), 0, nkv - 1)
 
 
-def _q_keep(off, j, i):
-    return off[0] + (i + 1) * _BQ - 1 >= off[1] + j * _BK
+def _q_keep(off, j, i, bq, bk):
+    return off[0] + (i + 1) * bq - 1 >= off[1] + j * bk
 
 
-def _q_clamp(off, j, i, nq):
+def _q_clamp(off, j, i, nq, bq, bk):
     # first useful q block for kv block j (may be >= nq: block unseen)
-    imin = (off[1] + j * _BK - off[0]) // _BQ
+    imin = (off[1] + j * bk - off[0]) // bq
     return jnp.clip(jnp.maximum(i, imin), 0, nq - 1)
+
+
+# ---------------------------------------------------------------------------
+# Triangular-grid causal kernels (aligned path). The iteration space is the
+# npairs = nq(nq+1)/2 lower-triangular tile pairs; two int32 tables map the
+# flat pair index p -> (i, j) and are scalar-prefetched so the BlockSpec
+# index maps can read them. i is the outer (Q, accumulate) block and runs
+# majored, so each output block's visits are consecutive (Pallas's revisit
+# rule) and the accumulators init at j == 0 and flush at the diagonal
+# j == i. For dk/dv the roles swap: j outer, i streamed from the diagonal
+# down, flush at i == nq - 1.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _tri_tables_qmajor(nq: int):
+    """(i_of_p, j_of_p): i-major lower-triangular pairs, j = 0..i."""
+    import numpy as np
+
+    i = np.repeat(np.arange(nq), np.arange(1, nq + 1))
+    j = np.concatenate([np.arange(r + 1) for r in range(nq)])
+    return i.astype(np.int32), j.astype(np.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _tri_tables_kmajor(nq: int):
+    """(j_of_p, i_of_p): j-major lower-triangular pairs, i = j..nq-1."""
+    import numpy as np
+
+    j = np.repeat(np.arange(nq), np.arange(nq, 0, -1))
+    i = np.concatenate([np.arange(r, nq) for r in range(nq)])
+    return j.astype(np.int32), i.astype(np.int32)
+
+
+def _fwd_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                    o_acc, m_acc, l_acc, *, bq: int, scale: float, prec):
+    p_id = pl.program_id(1)
+    i = itab[p_id]
+    j = jtab[p_id]
+
+    @pl.when(j == 0)
+    def _():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, _NEG_BIG)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    q = q_ref[0] * scale  # [BQ, D]
+    sc = _dot(q, k_ref[0], _LL, prec)  # [BQ, BK]
+    # the mask is the identity on sub-diagonal tiles (j < i): one formula
+    # serves every pair, and aligned diagonals guarantee every row sees
+    # its own key, so no fully-masked-row guard is needed here
+    sc = _causal_mask(sc, i * bq, j * bq)
+    m = m_acc[:, 0]
+    l = l_acc[:, 0]
+    m_new = jnp.maximum(m, jnp.max(sc, axis=1))
+    p = jnp.exp(sc - m_new[:, None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=1)
+    o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v_ref[0], _LF, prec)
+    m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
+    l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
+
+    @pl.when(j == i)
+    def _():
+        l = jnp.maximum(l_acc[:, 0], 1e-30)
+        o_ref[0] = o_acc[:] / l[:, None]
+        lse_ref[0] = (m_acc[:, 0] + jnp.log(l))[:, None]
+
+
+def _bwd_dq_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dq_ref, dq_acc, *, bq: int, scale: float,
+                       prec):
+    p_id = pl.program_id(1)
+    i = itab[p_id]
+    j = jtab[p_id]
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    k = k_ref[0]
+    p = _p_block(q_ref[0], k, lse_ref[0][:, 0], i * bq, j * bq,
+                 True, scale, prec)
+    dp = _dot(do_ref[0], v_ref[0], _LL, prec)
+    ds = p * (dp - delta_ref[0][:, 0][:, None])
+    dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF, prec)
+
+    @pl.when(j == i)
+    def _():
+        dq_ref[0] = dq_acc[:] * scale
+
+
+def _bwd_dkv_kernel_tri(jtab, itab, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                        *, nq: int, bq: int, scale: float, prec):
+    p_id = pl.program_id(1)
+    j = jtab[p_id]
+    i = itab[p_id]
+
+    @pl.when(i == j)  # first streamed Q block for this KV block
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    do = do_ref[0]
+    p = _p_block(q, k_ref[0], lse_ref[0][:, 0], i * bq, j * bq,
+                 True, scale, prec)
+    dv_acc[:] = dv_acc[:] + _dot(p, do, _FF, prec)
+    dp = _dot(do, v_ref[0], _LL, prec)
+    ds = p * (dp - delta_ref[0][:, 0][:, None])
+    dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF, prec)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:] * scale
+        dv_ref[0] = dv_acc[:]
 
 
 def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 o_acc, m_acc, l_acc, *, nkv: int, causal: bool, scale: float,
-                prec):
+                prec, bq: int, bk: int):
     qi = pl.program_id(1)
     j = pl.program_id(2)  # streamed KV block
 
@@ -177,7 +310,7 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0]
         sc = _dot(q, k, _LL, prec)  # [BQ, BK]
         if causal:
-            sc = _causal_mask(sc, off_ref[0] + qi * _BQ, off_ref[1] + j * _BK)
+            sc = _causal_mask(sc, off_ref[0] + qi * bq, off_ref[1] + j * bk)
         m = m_acc[:, 0]
         l = l_acc[:, 0]
         m_new = jnp.maximum(m, jnp.max(sc, axis=1))
@@ -185,7 +318,9 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             # rows whose running max is still _NEG_BIG have seen only
             # masked scores (sc - m_new == 0 there, NOT -inf): zero them
-            # so partially-masked tiles of non-aligned offsets stay exact
+            # so partially-masked tiles of non-aligned offsets stay exact.
+            # The threshold assumes real scores satisfy |score| << 5e29 —
+            # true for any f32 q,k (|q||k|*D would have to reach 1e29).
             p = jnp.where((m_new > _NEG_BIG * 0.5)[:, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1)
@@ -193,7 +328,7 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
         l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
-    _run_unless_skipped(causal, _kv_keep(off_ref, qi, j), compute)
+    _run_unless_skipped(causal, _kv_keep(off_ref, qi, j, bq, bk), compute)
 
     @pl.when(j == nkv - 1)
     def _():
@@ -209,7 +344,7 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, nkv: int, causal: bool, scale: float,
-                   prec):
+                   prec, bq: int, bk: int):
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -222,13 +357,13 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, 0]
         k = k_ref[0]
         p = _p_block(q_ref[0], k, lse_ref[0][:, 0],
-                     off_ref[0] + qi * _BQ, off_ref[1] + j * _BK,
+                     off_ref[0] + qi * bq, off_ref[1] + j * bk,
                      causal, scale, prec)
         dp = _dot(do, v_ref[0], _LL, prec)
         ds = p * (dp - delta[:, None])
         dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF, prec)
 
-    _run_unless_skipped(causal, _kv_keep(off_ref, qi, j), compute)
+    _run_unless_skipped(causal, _kv_keep(off_ref, qi, j, bq, bk), compute)
 
     @pl.when(j == nkv - 1)
     def _():
@@ -237,7 +372,8 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, nq: int, causal: bool, scale: float, prec):
+                    *, nq: int, causal: bool, scale: float, prec,
+                    bq: int, bk: int):
     ki = pl.program_id(1)
     i = pl.program_id(2)  # streamed Q block
 
@@ -251,14 +387,14 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         delta = delta_ref[0][:, 0]
         p = _p_block(q, k_ref[0], lse_ref[0][:, 0],
-                     off_ref[0] + i * _BQ, off_ref[1] + ki * _BK,
+                     off_ref[0] + i * bq, off_ref[1] + ki * bk,
                      causal, scale, prec)
         dv_acc[:] = dv_acc[:] + _dot(p, do, _FF, prec)
         dp = _dot(do, v_ref[0], _LL, prec)
         ds = p * (dp - delta[:, None])
         dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF, prec)
 
-    _run_unless_skipped(causal, _q_keep(off_ref, ki, i), compute)
+    _run_unless_skipped(causal, _q_keep(off_ref, ki, i, bq, bk), compute)
 
     @pl.when(i == nq - 1)
     def _():
@@ -266,15 +402,30 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:]
 
 
-def _check_shapes(s_q: int, s_kv: int, d: int):
-    if s_q % _BQ != 0 or s_kv % _BK != 0:
+def _resolve_blocks(s_q: int, s_kv: int, d: int, block_q, block_k):
+    """Pick (bq, bk) tile heights: explicit overrides, else the largest
+    default that divides the sequence (floor 128, the MXU edge)."""
+    if s_q % 128 != 0 or s_kv % 128 != 0:
         raise ValueError(
-            f"flash attention needs S divisible by {max(_BQ, _BK)}; got "
-            f"({s_q}, {s_kv}) "
+            f"flash attention needs S divisible by 128; got ({s_q}, {s_kv}) "
             "(use parallel.dense_attention for short/ragged sequences)"
+        )
+    bq = block_q or min(_BQ, s_q)
+    bk = block_k or min(_BK, s_kv)
+    if block_q is None:  # only DEFAULTS shrink to fit; overrides must fit
+        while s_q % bq != 0 and bq > 128:
+            bq //= 2
+    if block_k is None:
+        while s_kv % bk != 0 and bk > 128:
+            bk //= 2
+    if s_q % bq != 0 or s_kv % bk != 0 or bq % 128 != 0 or bk % 128 != 0:
+        raise ValueError(
+            f"tile heights must be multiples of 128 dividing S; got "
+            f"({bq}, {bk}) for S=({s_q}, {s_kv})"
         )
     if d > 256:
         raise ValueError(f"head dim {d} too large for a single VMEM tile")
+    return bq, bk
 
 
 def _grid_spec(grid, in_specs, out_specs, scratch_shapes):
@@ -287,28 +438,63 @@ def _grid_spec(grid, in_specs, out_specs, scratch_shapes):
     )
 
 
-def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI):
+def _fwd_tri(q3, k3, v3, scale: float, vma, prec, bq: int):
+    """Aligned-causal forward on the triangular pair grid."""
+    bh, s_q, d = q3.shape
+    nq = s_q // bq
+    itab, jtab = _tri_tables_qmajor(nq)
+    qspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, it[p], 0))
+    kvspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, jt[p], 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_tri, bq=bq, scale=scale, prec=prec),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, itab.shape[0]),
+            in_specs=[qspec, kvspec, kvspec],
+            out_specs=[
+                qspec,
+                pl.BlockSpec((1, bq, 1), lambda b, p, it, jt: (b, it[p], 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32, vma=vma),
+        ],
+        interpret=_interpret(),
+    )(jnp.asarray(itab), jnp.asarray(jtab), q3, k3, v3)
+    return o, lse
+
+
+def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI,
+         aligned: bool = False, bq: int = _BQ, bk: int = _BK):
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
-    nq, nkv = s_q // _BQ, s_kv // _BK
-    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j, off: (b, i, 0))
+    if causal and aligned and s_q == s_kv and bq == bk:
+        return _fwd_tri(q3, k3, v3, scale, vma, prec, bq)
+    nq, nkv = s_q // bq, s_kv // bk
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j, off: (b, i, 0))
     kvdx = (
-        (lambda b, i, j, off: (b, _kv_clamp(off, i, j, nkv), 0))
+        (lambda b, i, j, off: (b, _kv_clamp(off, i, j, nkv, bq, bk), 0))
         if causal
         else (lambda b, i, j, off: (b, j, 0))
     )
-    kvspec = pl.BlockSpec((1, _BK, d), kvdx)
+    kvspec = pl.BlockSpec((1, bk, d), kvdx)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, nkv=nkv, causal=causal, scale=scale,
-                          prec=prec),
+                          prec=prec, bq=bq, bk=bk),
         grid_spec=_grid_spec(
             (bh, nq, nkv),
             [qspec, kvspec, kvspec],
-            [qspec, pl.BlockSpec((1, _BQ, 1), lambda b, i, j, off: (b, i, 0))],
+            [qspec, pl.BlockSpec((1, bq, 1), lambda b, i, j, off: (b, i, 0))],
             [
-                pltpu.VMEM((_BQ, d), jnp.float32),    # o accumulator
-                pltpu.VMEM((_BQ, 128), jnp.float32),  # running max (col 0)
-                pltpu.VMEM((_BQ, 128), jnp.float32),  # running sum-exp (col 0)
+                pltpu.VMEM((bq, d), jnp.float32),    # o accumulator
+                pltpu.VMEM((bq, 128), jnp.float32),  # running max (col 0)
+                pltpu.VMEM((bq, 128), jnp.float32),  # running sum-exp (col 0)
             ],
         ),
         out_shape=[
@@ -320,44 +506,99 @@ def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI):
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash3(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI):
-    return _fwd(q3, k3, v3, off, causal, scale, vma, prec)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash3(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI,
+            aligned: bool = False, bq: int = _BQ, bk: int = _BK):
+    return _fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk)
 
 
-def _flash3_fwd(q3, k3, v3, off, causal, scale, vma, prec):
-    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma, prec)
+def _flash3_fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk):
+    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk)
     return (o, lse), (q3, k3, v3, off, o, lse)
 
 
-def _flash3_bwd(causal, scale, vma, prec, res, cts):
+def _bwd_tri(q3, k3, v3, do, lse, delta, scale: float, vma, prec, bq: int):
+    """Aligned-causal backward on the triangular pair grids."""
+    bh, s_q, d = q3.shape
+    nq = s_q // bq
+
+    itab, jtab = _tri_tables_qmajor(nq)
+    qspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, it[p], 0))
+    q1spec = pl.BlockSpec((1, bq, 1), lambda b, p, it, jt: (b, it[p], 0))
+    kvspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, jt[p], 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_tri, bq=bq, scale=scale, prec=prec),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, itab.shape[0]),
+            in_specs=[qspec, kvspec, kvspec, qspec, q1spec, q1spec],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
+        interpret=_interpret(),
+    )(jnp.asarray(itab), jnp.asarray(jtab), q3, k3, v3, do, lse, delta)
+
+    jtab2, itab2 = _tri_tables_kmajor(nq)
+    kspec = pl.BlockSpec((1, bq, d), lambda b, p, jt, it: (b, jt[p], 0))
+    qstream = pl.BlockSpec((1, bq, d), lambda b, p, jt, it: (b, it[p], 0))
+    q1stream = pl.BlockSpec((1, bq, 1), lambda b, p, jt, it: (b, it[p], 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_tri, nq=nq, bq=bq, scale=scale,
+                          prec=prec),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, jtab2.shape[0]),
+            in_specs=[qstream, kspec, kspec, qstream, q1stream, q1stream],
+            out_specs=[kspec, kspec],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
+        ],
+        interpret=_interpret(),
+    )(jnp.asarray(jtab2), jnp.asarray(itab2), q3, k3, v3, do, lse, delta)
+    return dq, dk, dv
+
+
+def _flash3_bwd(causal, scale, vma, prec, aligned, bq, bk, res, cts):
     q3, k3, v3, off, o, lse = res
     do, dlse = cts
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
-    nq, nkv = s_q // _BQ, s_kv // _BK
+    nq, nkv = s_q // bq, s_kv // bk
     do = do.astype(jnp.float32)
     # d lse/d scores is the softmax P itself, so the lse cotangent enters
     # dS = P (dP - delta) as a shift of delta: delta = rowsum(dO*O) - dlse
     delta = jnp.sum(do * o, axis=-1, keepdims=True) - dlse.astype(jnp.float32)
 
+    if causal and aligned and s_q == s_kv and bq == bk:
+        dq, dk, dv = _bwd_tri(q3, k3, v3, do, lse, delta, scale, vma, prec,
+                              bq)
+        doff = jax.custom_derivatives.zero_from_primal(off)
+        return dq, dk, dv, doff
+
     # dq: outer = Q blocks, streamed = KV blocks
-    qspec = pl.BlockSpec((1, _BQ, d), lambda b, i, j, off: (b, i, 0))
-    q1spec = pl.BlockSpec((1, _BQ, 1), lambda b, i, j, off: (b, i, 0))
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j, off: (b, i, 0))
+    q1spec = pl.BlockSpec((1, bq, 1), lambda b, i, j, off: (b, i, 0))
     kvdx = (
-        (lambda b, i, j, off: (b, _kv_clamp(off, i, j, nkv), 0))
+        (lambda b, i, j, off: (b, _kv_clamp(off, i, j, nkv, bq, bk), 0))
         if causal
         else (lambda b, i, j, off: (b, j, 0))
     )
-    kvspec = pl.BlockSpec((1, _BK, d), kvdx)
+    kvspec = pl.BlockSpec((1, bk, d), kvdx)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, nkv=nkv, causal=causal, scale=scale,
-                          prec=prec),
+                          prec=prec, bq=bq, bk=bk),
         grid_spec=_grid_spec(
             (bh, nq, nkv),
             [qspec, kvspec, kvspec, qspec, q1spec, q1spec],
             qspec,
-            [pltpu.VMEM((_BQ, d), jnp.float32)],
+            [pltpu.VMEM((bq, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
         interpret=_interpret(),
@@ -365,24 +606,24 @@ def _flash3_bwd(causal, scale, vma, prec, res, cts):
 
     # dk/dv: outer = KV blocks, streamed = Q blocks (causal: Q blocks
     # before the KV block see none of it — clamp onto the first useful)
-    kspec = pl.BlockSpec((1, _BK, d), lambda b, j, i, off: (b, j, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda b, j, i, off: (b, j, 0))
     qdx = (
-        (lambda b, j, i, off: (b, _q_clamp(off, j, i, nq), 0))
+        (lambda b, j, i, off: (b, _q_clamp(off, j, i, nq, bq, bk), 0))
         if causal
         else (lambda b, j, i, off: (b, i, 0))
     )
-    qstream = pl.BlockSpec((1, _BQ, d), qdx)
-    q1stream = pl.BlockSpec((1, _BQ, 1), qdx)
+    qstream = pl.BlockSpec((1, bq, d), qdx)
+    q1stream = pl.BlockSpec((1, bq, 1), qdx)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale,
-                          prec=prec),
+                          prec=prec, bq=bq, bk=bk),
         grid_spec=_grid_spec(
             (bh, nkv, nq),
             [qstream, kspec, kspec, qstream, q1stream, q1stream],
             [kspec, kspec],
             [
-                pltpu.VMEM((_BK, d), jnp.float32),
-                pltpu.VMEM((_BK, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
             ],
         ),
         out_shape=[
@@ -435,6 +676,8 @@ def flash_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     precision: str = "highest",
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Exact attention, blockwise in VMEM. q,k,v: [B, S, H, D] -> same.
 
@@ -447,13 +690,21 @@ def flash_attention(
     to ~1e-6; 'default' runs single bf16 passes — several times faster
     on the MXU and the standard choice for long-context training, with
     softmax statistics and accumulators still f32.
+
+    `block_q`/`block_k` override the VMEM tile heights (multiples of 128
+    dividing S; defaults swept on a v5e — see `_BQ`). Causal uses
+    equal tiles (the triangular grid pairs them).
     """
     b, s, h, d = q.shape
-    _check_shapes(s, s, d)
+    bq, bk = _resolve_blocks(s, s, d, block_q, block_k)
+    if causal:
+        bk = bq = min(bq, bk)  # triangular grid pairs equal tiles
     scale = _static_scale(sm_scale, d)
     off = jnp.zeros((2,), jnp.int32)
+    # offsets are statically zero: causal takes the triangular grid
     o, _ = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
-                   off, causal, scale, None, _prec_of(precision))
+                   off, causal, scale, None, _prec_of(precision), True,
+                   bq, bk)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
@@ -467,6 +718,8 @@ def flash_block(
     sm_scale: Optional[float] = None,
     vma=None,
     precision: str = "highest",
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One (Q block, KV block) partial attention with global positions.
 
@@ -484,14 +737,15 @@ def flash_block(
     """
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
-    _check_shapes(s_q, s_kv, d)
+    bq, bk = _resolve_blocks(s_q, s_kv, d, block_q, block_k)
     scale = _static_scale(sm_scale, d)
     off = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
     )
     o, lse = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
                      off, causal, scale,
-                     frozenset(vma) if vma else None, _prec_of(precision))
+                     frozenset(vma) if vma else None, _prec_of(precision),
+                     False, bq, bk)
     # both outputs stay f32 regardless of input dtype: partials feed an
     # online-softmax accumulation (ring.py fold_flash) and rounding them
     # before the merge would waste the f32 carry

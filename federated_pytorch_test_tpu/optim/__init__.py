@@ -12,6 +12,7 @@ single fused device computation with no host round-trips.
 """
 
 from federated_pytorch_test_tpu.optim.compact import compact_direction
+from federated_pytorch_test_tpu.optim.linesearch import vma_zero
 from federated_pytorch_test_tpu.optim.lbfgs import (
     LBFGSConfig,
     LBFGSState,
@@ -20,6 +21,7 @@ from federated_pytorch_test_tpu.optim.lbfgs import (
 )
 
 __all__ = [
+    "vma_zero",
     "LBFGSConfig",
     "LBFGSState",
     "compact_direction",

@@ -45,6 +45,7 @@ from jax import lax
 
 from federated_pytorch_test_tpu.optim.compact import compact_direction
 from federated_pytorch_test_tpu.optim.linesearch import (
+    vma_zero,
     backtracking_armijo,
     cubic_linesearch,
 )
@@ -268,18 +269,25 @@ def lbfgs_step(
         n_global = c.n_global + 1
         first_ever = n_global == 1
 
+        # a varying scalar zero (the gradient is always varying under
+        # shard_map): added to scalar cond outputs below so both branches
+        # produce identical varying-mesh-axis types under vma checking,
+        # with any axis name (this module is mesh-agnostic and cannot
+        # pvary by name) — see linesearch.vma_zero
+        vzero = vma_zero(c.g[0])
+
         def fresh_direction(c: _Carry):
             # reference src/lbfgsnew.py:550-557: steepest descent, reset
             # history and running statistics.
             return (
                 -c.g,
-                jnp.zeros_like(c.s_hist),
-                jnp.zeros_like(c.y_hist),
-                jnp.int32(0),
-                jnp.asarray(1.0, c.x.dtype),
-                c.alphabar,
-                jnp.zeros_like(c.running_avg),
-                jnp.zeros_like(c.running_avg_sq),
+                jnp.zeros_like(c.s_hist) + vzero,
+                jnp.zeros_like(c.y_hist) + vzero,
+                jnp.int32(0) + vzero.astype(jnp.int32),
+                jnp.asarray(1.0, c.x.dtype) + vzero,
+                c.alphabar + vzero,
+                jnp.zeros_like(c.running_avg) + vzero,
+                jnp.zeros_like(c.running_avg_sq) + vzero,
             )
 
         def update_direction(c: _Carry):
@@ -330,7 +338,16 @@ def lbfgs_step(
                 "pallas": _pallas_direction,
             }[config.direction]
             d = direction_fn(c.g, s_hist, y_hist, hist_count, h_diag)
-            return d, s_hist, y_hist, hist_count, h_diag, alphabar, ravg, ravgsq
+            return (
+                d,
+                s_hist + vzero,
+                y_hist + vzero,
+                hist_count + vzero.astype(jnp.int32),
+                h_diag + vzero,
+                alphabar + vzero,
+                ravg + vzero,
+                ravgsq + vzero,
+            )
 
         (d, s_hist, y_hist, hist_count, h_diag, alphabar, ravg, ravgsq) = lax.cond(
             first_ever, fresh_direction, update_direction, c
@@ -407,25 +424,33 @@ def lbfgs_step(
             done=done,
         )
 
+    # Exact zeros carrying the loss's varying-mesh-axis type. Under
+    # shard_map with vma checking the while_loop's carry must enter with
+    # the vma its body produces; `state` may arrive as unvarying constants
+    # (lbfgs_init) while the body mixes in the (always-varying) loss and
+    # gradient. Seeding every field costs nothing numerically — see
+    # linesearch.vma_zero on the inf/NaN safety.
+    vz = vma_zero(loss0)
+    iz = vz.astype(jnp.int32)
     init = _Carry(
         x=x,
         loss=loss0,
         g=g0,
         abs_grad_sum=abs_grad_sum0,
-        d=state.d,
-        t=state.t,
-        s_hist=state.s_hist,
-        y_hist=state.y_hist,
-        hist_count=state.hist_count,
-        h_diag=state.h_diag,
-        prev_grad=state.prev_grad,
-        prev_loss=state.prev_loss,
-        n_global=state.n_iter,
-        evals=jnp.int32(1),
-        n_inner=jnp.int32(0),
-        alphabar=lr,
-        running_avg=state.running_avg,
-        running_avg_sq=state.running_avg_sq,
+        d=state.d + vz,
+        t=state.t + vz,
+        s_hist=state.s_hist + vz,
+        y_hist=state.y_hist + vz,
+        hist_count=state.hist_count + iz,
+        h_diag=state.h_diag + vz,
+        prev_grad=state.prev_grad + vz,
+        prev_loss=state.prev_loss + vz,
+        n_global=state.n_iter + iz,
+        evals=jnp.int32(1) + iz,
+        n_inner=jnp.int32(0) + iz,
+        alphabar=lr + vz,
+        running_avg=state.running_avg + vz,
+        running_avg_sq=state.running_avg_sq + vz,
         done=abs_grad_sum0 <= tol_grad,
     )
 

@@ -32,6 +32,19 @@ Scalar = jnp.ndarray
 PhiFn = Callable[[Scalar], Scalar]  # alpha -> loss(x + alpha * d)
 
 
+def vma_zero(ref):
+    """Exact scalar zero carrying `ref`'s varying-mesh-axis type.
+
+    Loop carries under shard_map's vma checking must enter with the vma
+    their body produces; constants (jnp.int32(0), lr, ...) are unvarying,
+    so they are seeded by adding this zero derived from an always-varying
+    value (a loss or gradient element). nan_to_num keeps the zero exact
+    even when `ref` is inf/NaN — a divergent client must reach the
+    NaN-freeze paths with its carry unpoisoned, not absorb inf*0 = NaN.
+    """
+    return jnp.nan_to_num(ref, nan=0.0, posinf=0.0, neginf=0.0) * 0
+
+
 def _freeze(pred, new, old):
     """Keep `old` carry entries where `pred` holds (vmap-safety).
 
@@ -77,7 +90,11 @@ def backtracking_armijo(
         return _freeze(~active, (ci + 1, alpha_half, phi(alpha_half)), carry)
 
     f1 = phi(alphabar)
-    ci, alpha, _ = lax.while_loop(cond, body, (jnp.int32(0), alphabar, f1))
+    vz = vma_zero(f_old)
+    iz = vz.astype(jnp.int32)
+    ci, alpha, _ = lax.while_loop(
+        cond, body, (jnp.int32(0) + iz, alphabar + vz, f1 + vz)
+    )
     return alpha, ci + 1
 
 
@@ -176,8 +193,10 @@ def _zoom(
             found, (ci + 1, aj_new, bj_new, alphaj, found | found_now), carry
         )
 
+    vz = vma_zero(phi_0)
+    iz = vz.astype(jnp.int32)
     _, _, _, alphak, _ = lax.while_loop(
-        cond, body, (jnp.int32(0), a, b, a, jnp.bool_(False))
+        cond, body, (jnp.int32(0) + iz, a + vz, b + vz, a + vz, vz != 0)
     )
     return alphak
 
@@ -252,11 +271,13 @@ def cubic_linesearch(
         )
         return _freeze(code_in != 0, new, carry)
 
-    alpha1 = jnp.asarray(10.0 * lr, dt)
+    vz = vma_zero(phi_0)
+    iz = vz.astype(jnp.int32)
+    alpha1 = jnp.asarray(10.0 * lr, dt) + vz
     ci, alphai, alphai1, _, code = lax.while_loop(
         cond,
         body,
-        (jnp.int32(0), alpha1, jnp.asarray(0.0, dt), phi_0, jnp.int32(0)),
+        (jnp.int32(0) + iz, alpha1, vz, phi_0, jnp.int32(0) + iz),
     )
 
     def do_zoom(bracket):
@@ -266,7 +287,9 @@ def cubic_linesearch(
     alphak = lax.switch(
         jnp.clip(code, 0, 3),
         [
-            lambda _: jnp.asarray(lr, dt),  # loop exhausted: fall back to lr
+            # loop exhausted: fall back to lr (+vz matches the other
+            # branches' varying-axis type)
+            lambda _: jnp.asarray(lr, dt) + vz,
             lambda _: alphai,  # accepted directly
             lambda _: do_zoom((alphai1, alphai)),
             lambda _: do_zoom((alphai, alphai1)),

@@ -142,10 +142,13 @@ def ring_attention(
 
         blk_max = jnp.max(scores, axis=-1)  # [B,H,Sq]
         m_new = jnp.maximum(m, blk_max)
-        # exp(_NEG_BIG - m_new) == 0 exactly, so masked entries vanish and
-        # a fully-masked block contributes nothing (m_new stays _NEG_BIG
-        # only while o == l == 0)
         probs = jnp.exp(scores - m_new[..., None])  # [B,H,Sq,Skv]
+        # rows with no visible key in THIS block (blk_max == _NEG_BIG)
+        # must contribute zero weight even if the accumulator is still
+        # empty (m == -1e30, where exp(scores - m_new) == exp(0) == 1
+        # would add phantom weight) — same order-independence guard as
+        # fold_flash's beta
+        probs = jnp.where((blk_max > _NEG_BIG * 0.5)[..., None], probs, 0.0)
         corr = jnp.exp(m - m_new)  # [B,H,Sq]
         l_new = l * corr + jnp.sum(probs, axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
@@ -159,9 +162,10 @@ def ring_attention(
             m' = max(m, lse);  l' = l*e^{m-m'} + e^{lse-m'}
             o' = o*e^{m-m'} + o_blk*e^{lse-m'}      (o_blk normalized)
 
-        Step 0 folds the diagonal (resident) block first, so by the time
-        a causal row meets a fully-masked block (lse = -1e30) its running
-        max is finite and the block's weight underflows to exactly 0.
+        The merge is order-independent: a fully-masked partial
+        (lse = -1e30) gets its block weight forced to exactly 0, so it
+        contributes nothing even if it meets a still-empty accumulator
+        (m = -1e30), where exp(lse - m_new) would otherwise be exp(0) = 1.
         """
         from federated_pytorch_test_tpu.ops.flash_attention import flash_block
 
@@ -174,7 +178,9 @@ def ring_attention(
         )  # o_blk [B,H,Sq,D]: already the accumulator layout
         m_new = jnp.maximum(m, lse)
         alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(lse - m_new)
+        # zero (not exp(0)=1) weight for masked partials: lse = _NEG_BIG
+        # means "no visible keys in this block", regardless of m_new
+        beta = jnp.where(lse > _NEG_BIG * 0.5, jnp.exp(lse - m_new), 0.0)
         o_new = o * alpha[..., None] + o_blk.astype(o.dtype) * beta[..., None]
         return o_new, m_new, l * alpha + beta
 

@@ -80,14 +80,23 @@ class MetricsRecorder:
                 f"dual={float(dual):e}"
             )
 
-    def accuracies(self, accs, *, nloop, group, nadmm) -> None:
+    def accuracies(
+        self, accs, *, nloop, group, nadmm, epoch=None, minibatch=None
+    ) -> None:
         """Per-client top-1 test accuracy (fractions in [0,1]).
 
         Reference: `verification_error_check` prints per-client percentages
-        (src/federated_trio.py:199-223).
+        (src/federated_trio.py:199-223). `epoch`/`minibatch` are set on the
+        per-batch cadence (`eval_every_batch`, the reference's
+        check_results=True telemetry, src/no_consensus_trio.py:266-267).
         """
         vals = [float(a) for a in accs]
-        self.log("test_accuracy", vals, nloop=nloop, group=group, nadmm=nadmm)
+        ctx = dict(nloop=nloop, group=group, nadmm=nadmm)
+        if epoch is not None:
+            ctx["epoch"] = epoch
+        if minibatch is not None:
+            ctx["minibatch"] = minibatch
+        self.log("test_accuracy", vals, **ctx)
         if self.verbose:
             for k, a in enumerate(vals):
                 print(

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# CI gate: the smoke tier on the virtual 8-device CPU mesh (<2 min).
+#
+# Tiers (markers declared in pyproject.toml):
+#   pytest -m smoke                     — this script's gate, <2 min
+#   pytest -m "not smoke and not slow"  — middle tier (~3 min): partition,
+#                                         models
+#   pytest -m slow                      — full integration (~20+ min):
+#                                         engine sweeps, Pallas interpret
+#                                         kernels, ring, 2-process runs
+# Run all three for a full validation; tests/conftest.py forces the CPU
+# platform and 8 virtual devices, so no TPU is needed.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -m smoke -q "$@"

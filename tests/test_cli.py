@@ -1,9 +1,13 @@
 """CLI surface tests: the `python -m federated_pytorch_test_tpu` driver."""
 
+import pytest
+
 import json
 import os
 import subprocess
 import sys
+
+pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = dict(

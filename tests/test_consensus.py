@@ -20,6 +20,8 @@ from federated_pytorch_test_tpu.consensus import (
 )
 from federated_pytorch_test_tpu.parallel import CLIENT_AXIS, client_mesh
 
+pytestmark = pytest.mark.smoke  # fast CI tier
+
 K, N = 3, 11
 
 

@@ -16,6 +16,8 @@ from federated_pytorch_test_tpu.engine import (
     get_preset,
 )
 
+pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
+
 SRC = synthetic_cifar(n_train=240, n_test=60)
 
 
@@ -369,3 +371,92 @@ def test_k6_clients_on_3_devices_local_blocks():
         blk = flat[:, seg.start : seg.start + seg.size]
         assert np.abs(blk - blk[:1]).max() == 0.0  # all 6 synced
     assert np.isfinite(np.mean(rec.series["train_loss"][-1]["value"]))
+
+
+def test_resume_replays_exact_trajectory(tmp_path):
+    # the claim at utils/checkpoint.py: a resumed run replays the EXACT
+    # trajectory of an uninterrupted one. Run 2 loops straight; run 1 loop,
+    # checkpoint, resume into loop 2 from a fresh Trainer; the continued
+    # params AND the continued metric series must be bit-identical.
+    common = dict(
+        model="net", nadmm=2, save_model=True, check_results=True,
+        eval_batch=30,
+    )
+    cfg_a = tiny("fedavg", nloop=2, checkpoint_dir=str(tmp_path / "a"),
+                 **common)
+    tr_a = Trainer(cfg_a, verbose=False, source=SRC)
+    tr_a.group_order = tr_a.group_order[:1]
+    rec_a = tr_a.run()
+
+    # "interrupted" run: same config but stop after loop 0 (loop counters,
+    # not cfg.nloop, seed the epoch shuffles, so loop 0 is identical)
+    cfg_b = tiny("fedavg", nloop=1, checkpoint_dir=str(tmp_path / "b"),
+                 **common)
+    tr_b = Trainer(cfg_b, verbose=False, source=SRC)
+    tr_b.group_order = tr_b.group_order[:1]
+    tr_b.run()
+
+    # resume for loop 1
+    cfg_b2 = cfg_b.replace(nloop=2, load_model=True)
+    tr_b2 = Trainer(cfg_b2, verbose=False, source=SRC)
+    tr_b2.group_order = tr_b2.group_order[:1]
+    assert tr_b2._completed_nloops == 1  # restored cursor
+    rec_b2 = tr_b2.run()
+
+    np.testing.assert_array_equal(
+        np.asarray(tr_b2.flat), np.asarray(tr_a.flat)
+    )
+    # continued series == the uninterrupted run's loop-1 slice, bit for bit
+    for name in ("train_loss", "dual_residual", "test_accuracy"):
+        a_vals = [r["value"] for r in rec_a.series[name] if r["nloop"] == 1]
+        b_vals = [r["value"] for r in rec_b2.series[name]]
+        assert a_vals == b_vals, name
+
+
+def test_eval_every_batch_cadence():
+    # reference check_results=True evaluates after EVERY batch
+    # (reference src/no_consensus_trio.py:266-267): the knob must produce
+    # one accuracy record per minibatch and leave training unchanged.
+    base = dict(model="net1", nepoch=2, check_results=True, eval_batch=30)
+    cfg = tiny("no_consensus", eval_every_batch=True, **base)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    rec = tr.run()
+
+    accs = rec.series["test_accuracy"]
+    # 240 train / 3 clients = 80/client; batch 40 => 2 minibatches/epoch
+    assert len(accs) == 2 * 2
+    assert [a["minibatch"] for a in accs] == [0, 1, 0, 1]
+
+    cfg2 = tiny("no_consensus", eval_every_batch=False, **base)
+    tr2 = Trainer(cfg2, verbose=False, source=SRC)
+    tr2.run()
+    np.testing.assert_allclose(
+        np.asarray(tr.flat), np.asarray(tr2.flat), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_bfloat16_resnet_bn_stats_match_f32():
+    # the bf16 BN computes its batch statistics in bf16 (fusable
+    # reductions, models/resnet.py:_bn): training must stay finite and
+    # the running stats must agree with the f32 path to bf16 tolerance
+    import jax
+
+    def run(dtype):
+        cfg = tiny("fedavg_resnet", batch=30, nadmm=1, compute_dtype=dtype)
+        tr = Trainer(cfg, verbose=False, source=SRC)
+        tr.group_order = [9]  # linear head: cheapest resnet group
+        rec = tr.run()
+        stats = np.concatenate(
+            [np.ravel(x) for x in jax.tree.leaves(tr.stats)]
+        )
+        return rec, stats
+
+    rec16, stats16 = run("bfloat16")
+    rec32, stats32 = run("float32")
+    assert np.isfinite(stats16).all()
+    assert np.isfinite(np.mean(rec16.series["train_loss"][-1]["value"]))
+    # bf16 mantissa is 8 bits: stats should track f32 to ~1e-2 relative
+    np.testing.assert_allclose(stats16, stats32, rtol=3e-2, atol=3e-2)
+    l16 = np.mean(rec16.series["train_loss"][-1]["value"])
+    l32 = np.mean(rec32.series["train_loss"][-1]["value"])
+    assert abs(l16 - l32) < 0.15

@@ -16,6 +16,8 @@ from federated_pytorch_test_tpu.ops.flash_attention import (
 )
 from federated_pytorch_test_tpu.parallel import dense_attention
 
+pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
+
 
 def _qkv(b=2, s=256, h=2, d=32, seed=0):
     rng = np.random.default_rng(seed)

@@ -17,6 +17,8 @@ from federated_pytorch_test_tpu.optim import (
     lbfgs_step,
 )
 
+pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
+
 
 def _quadratic(n=12, seed=0):
     rng = np.random.RandomState(seed)

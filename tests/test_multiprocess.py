@@ -25,6 +25,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "multiprocess_worker.py")
 

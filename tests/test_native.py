@@ -13,6 +13,8 @@ import pytest
 
 from federated_pytorch_test_tpu.data import native
 
+pytestmark = pytest.mark.smoke  # fast CI tier
+
 
 def _native_available() -> bool:
     return native.get_lib() is not None
@@ -206,6 +208,7 @@ def test_numpy_fallback_same_contract():
     code = """
 import numpy as np
 from federated_pytorch_test_tpu.data import native
+
 assert native.get_lib() is None
 rng = np.random.default_rng(0)
 flat = rng.integers(0, 256, size=(17, 3072), dtype=np.uint8)

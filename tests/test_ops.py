@@ -20,6 +20,8 @@ from federated_pytorch_test_tpu.ops import (
 from federated_pytorch_test_tpu.optim import LBFGSConfig, lbfgs_init, lbfgs_step
 from federated_pytorch_test_tpu.optim.compact import compact_direction
 
+pytestmark = pytest.mark.smoke  # fast CI tier
+
 
 def _rel_close(a, b, rtol):
     scale = np.max(np.abs(np.asarray(b))) + 1e-30

@@ -22,6 +22,8 @@ from federated_pytorch_test_tpu.parallel import (
     weighted_client_mean,
 )
 
+pytestmark = pytest.mark.smoke  # fast CI tier
+
 
 def _run(mesh, fn, *args):
     sharded = shard_map(

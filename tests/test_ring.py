@@ -22,6 +22,8 @@ from federated_pytorch_test_tpu.parallel import (
     ring_attention,
 )
 
+pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
+
 
 def _seq_mesh(p=8):
     devs = jax.devices()
