@@ -1,0 +1,100 @@
+"""Client-scaling sweep on one real TPU chip.
+
+The reference hard-codes K=3 clients (reference src/federated_trio.py:
+98-100). This framework folds ANY K into vmapped local blocks per device
+(parallel/mesh.py), so one chip can simulate a whole pod's worth of
+clients — the single-chip half of the scale-out story. This sweep runs
+the flagship workload (ResNet18 FedAvg epoch, batch 32/client, stochastic
+L-BFGS with line search) at K = 3/6/12/24/48 local clients on ONE device
+and records throughput, answering: where does the vmapped client batch
+saturate the chip?
+
+Writes `client_scaling_tpu.json` next to this file. Requires a TPU.
+
+Run: python benchmarks/client_scaling_tpu.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KS = (3, 6, 12, 24, 48)
+BATCH = 32
+STEPS = 8
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    rows = []
+    for k in KS:
+        src = synthetic_cifar(n_train=k * BATCH * STEPS, n_test=64)
+        cfg = get_preset(
+            "fedavg_resnet", n_clients=k, batch=BATCH, check_results=False
+        )
+        tr = Trainer(cfg, verbose=False, source=src)
+        gid = tr.group_order[0]
+        epoch_fn, _, init_fn = tr._fns(gid)
+        lstate, y, z, rho, extra = init_fn(tr.flat)
+        flat, stats = tr.flat, tr.stats
+        idx = tr._epoch_indices(0, gid, 0, 0)[:STEPS]
+
+        def run(flat, lstate, stats):
+            flat, lstate, stats, _ = epoch_fn(
+                flat, lstate, stats, tr.shard_imgs, tr.shard_labels,
+                idx, tr.mean, tr.std, y, z, rho,
+            )
+            return flat, lstate, stats
+
+        # warmup/compile; scalar fetch is the true completion barrier on
+        # the tunneled runtime (see bench.py)
+        flat, lstate, stats = run(flat, lstate, stats)
+        float(jnp.sum(flat[:, 0]))
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            flat, lstate, stats = run(flat, lstate, stats)
+            float(jnp.sum(flat[:, 0]))
+            dt = min(dt, time.perf_counter() - t0)
+
+        sps = STEPS * k * BATCH / dt
+        row = {
+            "n_clients": k,
+            "samples_per_sec": round(sps, 1),
+            "epoch_time_s": round(dt, 4),
+            "samples_per_sec_per_client": round(sps / k, 1),
+            "scaling_efficiency_vs_k3": None,  # filled below
+        }
+        rows.append(row)
+        print(json.dumps(row))
+
+    base = rows[0]["samples_per_sec"] / rows[0]["n_clients"]
+    for r in rows:
+        r["scaling_efficiency_vs_k3"] = round(
+            (r["samples_per_sec"] / r["n_clients"]) / base, 3
+        )
+
+    out = {
+        "workload": f"ResNet18 FedAvg jitted epoch, batch {BATCH}/client, "
+                    f"{STEPS} lockstep minibatches, K vmapped client blocks "
+                    "on ONE device (group = first shuffled block)",
+        "device": str(jax.devices()[0]),
+        "rows": rows,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "client_scaling_tpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,20 +1,50 @@
-"""Convergence parity: reference algorithm (torch) vs this framework (JAX),
-same data, same hyper-parameters, accuracy after every averaging round.
+"""Convergence parity v2: reference algorithm (torch) vs this framework
+(JAX), same data, same hyper-parameters — a DISCRIMINATING oracle.
 
-The reference repo publishes no curves (BASELINE.md), and this environment
-has no CIFAR archive, so parity is established on the deterministic
-synthetic dataset both sides can load: 3 simple-CNN clients, disjoint
-shards, partial-parameter FedAvg (one layer group per round), stochastic
-L-BFGS inner solver. The torch side imports the reference's own
-`LBFGSNew` optimizer from /root/reference/src (imported, NOT copied) and
-re-drives its algorithm exactly as SURVEY.md §3.1 documents it: freeze all
-but one layer pair, fresh optimizer per group, average the active group
-across clients after each round (reference src/federated_trio.py:256-363).
+v1's synthetic set was linearly separable: every healthy configuration
+reached 1.0 accuracy, so the curves could not distinguish a correct
+implementation from a subtly wrong one. v2 hardens the dataset (class
+overlap + 25% label noise -> test accuracy plateaus near the ~0.78 Bayes
+ceiling, see data/cifar.synthetic_cifar) and compares, per averaging
+round, BOTH the accuracy trajectory AND the consensus-residual
+trajectories, with explicit tolerance bands:
 
-Writes benchmarks/convergence_parity.json:
-  {"reference": {"acc": [...]}, "framework": {"acc": [...]}, ...}
+  * accuracy: |final_fw - final_ref| <= 0.05 and mean per-round
+    |diff| <= 0.06 (the inner-epoch minibatch shuffles are independent
+    streams, so curves agree statistically, not bitwise);
+  * residuals: median |log10(fw / ref)| <= 0.5 over the aligned rounds
+    (residuals decay over orders of magnitude; half an order is tight
+    enough to catch a wrong z/y/rho update and loose enough for the
+    shuffle noise);
+  * ADMM mean rho: final ratio in [0.5, 2] (BB adaptation must walk the
+    same path).
 
-Run: python benchmarks/convergence_parity.py   (~2-4 min, CPU)
+Four configurations, mirroring the reference driver pairs:
+
+  fedavg_simple  Net, FULL schedule: nloop x 5 groups x nadmm=3
+  admm_simple    Net, FULL schedule: nloop x 5 groups x nadmm=5, BB rho
+  fedavg_resnet  ResNet18, REDUCED: nloop=1, first 2 shuffled blocks,
+                 nadmm=3 (torch ResNet at full schedule is hours on this
+                 1-core host; 2 blocks exercise BN + block partition)
+  admm_resnet    ResNet18, REDUCED: same blocks, nadmm=3, fixed rho
+
+The torch side imports the reference's own `LBFGSNew` from
+/root/reference/src (imported, NOT copied) and re-drives the algorithms
+exactly as SURVEY.md §3.1/§3.2 document them; the ADMM/BB semantics
+follow consensus/admm.py, which was trajectory-validated against a numpy
+mirror of the reference in round 1.
+
+Run (one config per invocation; results merge into
+benchmarks/convergence_parity.json):
+
+  python benchmarks/convergence_parity.py fedavg_simple
+  python benchmarks/convergence_parity.py admm_simple
+  python benchmarks/convergence_parity.py fedavg_resnet
+  python benchmarks/convergence_parity.py admm_resnet
+
+Env: PARITY_NLOOP overrides the simple configs' outer-loop count
+(default 8; the reference uses 12 — pure runtime knob, the schedule
+structure is identical).
 """
 
 from __future__ import annotations
@@ -30,84 +60,167 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 K = 3
-BATCH = 64
-NLOOP = 2  # outer loops over the 5 layer groups
-NADMM = 2  # averaging rounds per group
-N_TRAIN = 960  # per all clients; 320/client => 5 lockstep batches
-N_TEST = 300
 SEED = 0
+N_TEST = 300
+NLOOP_SIMPLE = int(os.environ.get("PARITY_NLOOP", "8"))
+
+# dataset hardness: overlap shrinks class margins, label noise caps the
+# achievable test accuracy at ~0.78 — the plateau the oracle needs
+HARDNESS = dict(noise=110.0, overlap=0.35, label_noise=0.25)
+
+SIMPLE = dict(batch=64, n_train=960)   # 320/client -> 5 lockstep batches
+RESNET = dict(batch=32, n_train=192)   # 64/client -> 2 lockstep batches
+
+REFERENCE_SRC = os.environ.get("REFERENCE_SRC", "/root/reference/src")
+
+ADMM_RHO0 = 1e-3
+BB = dict(period=2, corr_min=0.2, eps=1e-3, rho_max=0.1)
 
 
-def synthetic():
+def synthetic(n_train):
     from federated_pytorch_test_tpu.data import synthetic_cifar
 
-    # noise high enough that the task is NOT saturated in one round —
-    # otherwise both sides hit ceiling and the curves say nothing
     return synthetic_cifar(
-        n_train=N_TRAIN, n_test=N_TEST, seed=SEED, noise=150.0
+        n_train=n_train, n_test=N_TEST, seed=SEED, **HARDNESS
     )
 
 
 # --------------------------------------------------------------- torch side
 
 
-REFERENCE_SRC = os.environ.get("REFERENCE_SRC", "/root/reference/src")
-if not os.path.isdir(REFERENCE_SRC):  # fail fast, before any training runs
-    sys.exit(
-        f"reference checkout not found at {REFERENCE_SRC} "
-        "(set REFERENCE_SRC to its src/ directory)"
-    )
-
-
-def run_reference(src) -> list:
+def _torch_models(kind):
     import torch
     import torch.nn as nn
     import torch.nn.functional as F
 
+    if kind == "net":
+
+        class Net(nn.Module):
+            # the reference's 5-layer simple CNN shape-for-shape
+            # (reference src/simple_models.py:9-39), ELU, NCHW
+            def __init__(self):
+                super().__init__()
+                self.conv1 = nn.Conv2d(3, 6, 5)
+                self.conv2 = nn.Conv2d(6, 16, 5)
+                self.fc1 = nn.Linear(400, 120)
+                self.fc2 = nn.Linear(120, 84)
+                self.fc3 = nn.Linear(84, 10)
+
+            def forward(self, x):
+                x = F.max_pool2d(F.elu(self.conv1(x)), 2)
+                x = F.max_pool2d(F.elu(self.conv2(x)), 2)
+                x = x.flatten(1)
+                x = F.elu(self.fc1(x))
+                x = F.elu(self.fc2(x))
+                return self.fc3(x)
+
+        groups = [["conv1"], ["conv2"], ["fc1"], ["fc2"], ["fc3"]]
+        order = [2, 0, 1, 3, 4]  # reference src/simple_models.py:38-39
+        return Net, groups, order
+
+    class Block(nn.Module):
+        # BasicBlock with ELU (reference src/federated_trio_resnet.py:65-87)
+        def __init__(self, inp, planes, stride):
+            super().__init__()
+            self.conv1 = nn.Conv2d(inp, planes, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(planes)
+            self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(planes)
+            self.short = None
+            if stride != 1 or inp != planes:
+                self.short = nn.Sequential(
+                    nn.Conv2d(inp, planes, 1, stride, bias=False),
+                    nn.BatchNorm2d(planes),
+                )
+
+        def forward(self, x):
+            out = F.elu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            sc = x if self.short is None else self.short(x)
+            return F.elu(out + sc)
+
+    class ResNet18(nn.Module):
+        # stage layout (reference src/federated_trio_resnet.py:118-152)
+        STAGES = [(64, 1), (64, 1), (128, 2), (128, 1),
+                  (256, 2), (256, 1), (512, 2), (512, 1)]
+
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(64)
+            inp = 64
+            for i, (planes, stride) in enumerate(self.STAGES):
+                setattr(self, f"block{i}", Block(inp, planes, stride))
+                inp = planes
+            self.linear = nn.Linear(512, 10)
+
+        def forward(self, x):
+            x = F.elu(self.bn1(self.conv1(x)))
+            for i in range(8):
+                x = getattr(self, f"block{i}")(x)
+            x = F.avg_pool2d(x, 4)
+            return self.linear(x.flatten(1))
+
+    # the decoded upidx table: [stem, block0..7, linear]
+    # (reference src/federated_trio_resnet.py:174-178)
+    groups = [["conv1", "bn1"]] + [[f"block{i}"] for i in range(8)] + [["linear"]]
+    rng = np.random.RandomState(0)  # reference :296-297
+    order = list(rng.permutation(10))
+    return ResNet18, groups, order
+
+
+def _trainable(net, groups, gid):
+    """Freeze all but group `gid`; return its live parameter list."""
+    want = set(groups[gid])
+    params = []
+    for name, mod in net.named_children():
+        on = name in want
+        for p in mod.parameters():
+            p.requires_grad = on
+        if on:
+            params.extend(mod.parameters())
+    return params
+
+
+def _flat(params):
+    import torch
+
+    with torch.no_grad():
+        return torch.cat([p.reshape(-1) for p in params]).clone()
+
+
+def _put_flat(params, vec):
+    import torch
+
+    with torch.no_grad():
+        i = 0
+        for p in params:
+            n = p.numel()
+            p.copy_(vec[i : i + n].reshape(p.shape))
+            i += n
+
+
+def run_reference(kind, src, batch, nloop, nadmm, strategy, bb, group_slice):
+    import torch
+    import torch.nn as nn
+
     sys.path.insert(0, REFERENCE_SRC)
     from lbfgsnew import LBFGSNew  # reference optimizer (imported, not copied)
 
+    Model, groups, order = _torch_models(kind)
+    order = order[:group_slice] if group_slice else order
+    L = len(groups)
+
     torch.manual_seed(SEED)
-
-    class Net(nn.Module):
-        # the reference's 5-layer simple CNN shape-for-shape
-        # (reference src/simple_models.py:9-39), ELU, NCHW
-        def __init__(self):
-            super().__init__()
-            self.conv1 = nn.Conv2d(3, 6, 5)
-            self.conv2 = nn.Conv2d(6, 16, 5)
-            self.fc1 = nn.Linear(400, 120)
-            self.fc2 = nn.Linear(120, 84)
-            self.fc3 = nn.Linear(84, 10)
-
-        def forward(self, x):
-            x = F.max_pool2d(F.elu(self.conv1(x)), 2)
-            x = F.max_pool2d(F.elu(self.conv2(x)), 2)
-            x = x.flatten(1)
-            x = F.elu(self.fc1(x))
-            x = F.elu(self.fc2(x))
-            return self.fc3(x)
-
-    mods = ["conv1", "conv2", "fc1", "fc2", "fc3"]
-    train_order = [2, 0, 1, 3, 4]  # reference src/simple_models.py:38-39
-
-    # identical common-seed init across clients (reference
-    # src/federated_trio.py:229-236)
     nets = []
     for _ in range(K):
-        torch.manual_seed(SEED)
-        nets.append(Net())
+        torch.manual_seed(SEED)  # common-seed init across clients
+        nets.append(Model())
 
-    # disjoint contiguous shards; the reference's unbiased normalization
-    # Normalize((.5,.5,.5),(.5,.5,.5)) after ToTensor, i.e.
-    # (x/255 - 0.5)/0.5 (reference src/no_consensus_trio.py:34-38) —
-    # identical to the framework side's UNBIASED stat, so both curves see
-    # the SAME input scaling; NCHW for torch
-    def norm(a):
+    def norm(a):  # unbiased (x/255 - .5)/.5, NCHW
         return (a.astype(np.float32) / 255.0 - 0.5) / 0.5
 
-    imgs = norm(src.train_images)
-    labs = src.train_labels.astype(np.int64)
+    imgs, labs = norm(src.train_images), src.train_labels.astype(np.int64)
     per = len(imgs) // K
     shards = [
         (
@@ -118,120 +231,287 @@ def run_reference(src) -> list:
     ]
     te_x = torch.from_numpy(norm(src.test_images).transpose(0, 3, 1, 2))
     te_y = torch.from_numpy(src.test_labels.astype(np.int64))
-
     crit = nn.CrossEntropyLoss()
     rng = np.random.default_rng(SEED)
 
     def accuracy():
         accs = []
-        with torch.no_grad():
-            for net in nets:
-                pred = net(te_x).argmax(1)
-                accs.append(float((pred == te_y).float().mean()))
+        for net in nets:
+            net.eval()
+            with torch.no_grad():
+                accs.append(float((net(te_x).argmax(1) == te_y).float().mean()))
+            net.train()
         return accs
 
-    def unfreeze_only(net, gid):
-        want = mods[gid]
-        for name, mod in net.named_children():
-            for p in mod.parameters():
-                p.requires_grad = name == want
-        return list(getattr(net, want).parameters())
+    rho_store = {g: [ADMM_RHO0] * K for g in range(L)}  # persistent rho
+    acc, dual_r, primal_r, rho_r = [accuracy()], [], [], []
 
-    series = [accuracy()]
-    for nloop in range(NLOOP):
-        for gid in train_order:
+    for loop in range(nloop):
+        for gid in order:
+            plists = [_trainable(net, groups, gid) for net in nets]
             opts = [
-                LBFGSNew(
-                    unfreeze_only(net, gid),
-                    history_size=10,
-                    max_iter=4,
-                    line_search_fn=True,
-                    batch_mode=True,
-                )
-                for net in nets
+                LBFGSNew(pl, history_size=10, max_iter=4,
+                         line_search_fn=True, batch_mode=True)
+                for pl in plists
             ]
-            for nadmm in range(NADMM):
-                # one epoch of lockstep minibatches per round
-                order = [rng.permutation(per) for _ in range(K)]
-                for s in range(per // BATCH):
+            n = _flat(plists[0]).numel()
+            z = torch.zeros(n)
+            ys = [torch.zeros(n) for _ in range(K)]
+            rho = [float(r) for r in rho_store[gid]]
+            # BB state quirks (consensus/admm.py; reference :299-302):
+            # yhat0 initializes to the group's STARTING parameter values
+            yhat0 = [_flat(pl) for pl in plists]
+            x0 = [torch.zeros(n) for _ in range(K)]
+
+            for it in range(nadmm):
+                # one epoch of lockstep minibatches (x-update)
+                orders = [rng.permutation(per) for _ in range(K)]
+                for s in range(per // batch):
                     for c in range(K):
-                        x = shards[c][0][order[c][s * BATCH : (s + 1) * BATCH]]
-                        y = shards[c][1][order[c][s * BATCH : (s + 1) * BATCH]]
+                        sel = orders[c][s * batch : (s + 1) * batch]
+                        bx, by = shards[c][0][sel], shards[c][1][sel]
 
                         def closure():
                             if torch.is_grad_enabled():
                                 opts[c].zero_grad()
-                            loss = crit(nets[c](x), y)
+                            loss = crit(nets[c](bx), by)
+                            if strategy == "admm":
+                                # LIVE cat view: the aug-Lagrangian term is
+                                # part of the autograd graph (reference
+                                # src/consensus_admm_trio.py:343)
+                                xv = torch.cat(
+                                    [p.reshape(-1) for p in plists[c]]
+                                )
+                                diff = xv - z
+                                loss = loss + torch.dot(ys[c], diff) \
+                                    + 0.5 * rho[c] * torch.dot(diff, diff)
                             if loss.requires_grad:
                                 loss.backward()
                             return loss
 
                         opts[c].step(closure)
-                # FedAvg the ACTIVE group only (reference :353-363)
-                with torch.no_grad():
-                    mod_params = [
-                        list(getattr(net, mods[gid]).parameters()) for net in nets
-                    ]
-                    for pi in range(len(mod_params[0])):
-                        mean = sum(mp[pi] for mp in mod_params) / K
-                        for mp in mod_params:
-                            mp[pi].copy_(mean)
-                series.append(accuracy())
-    return series
+
+                xs = [_flat(pl) for pl in plists]
+                if strategy == "fedavg":
+                    znew = sum(xs) / K
+                    dual_r.append(float(torch.norm(z - znew)) / n)
+                    for pl in plists:
+                        _put_flat(pl, znew)
+                    z = znew
+                else:
+                    if bb:
+                        due = it > 0 and it % BB["period"] == 0
+                        yhat = [ys[c] + rho[c] * (xs[c] - z) for c in range(K)]
+                        if due:
+                            for c in range(K):
+                                dy, dx = yhat[c] - yhat0[c], xs[c] - x0[c]
+                                d11 = float(torch.dot(dy, dy))
+                                d12 = float(torch.dot(dy, dx))
+                                d22 = float(torch.dot(dx, dx))
+                                if (abs(d12) > BB["eps"] and d11 > BB["eps"]
+                                        and d22 > BB["eps"]):
+                                    alpha = d12 / np.sqrt(d11 * d22)
+                                    a_sd, a_mg = d11 / d12, d12 / d22
+                                    a_hat = a_mg if 2 * a_mg > a_sd \
+                                        else a_sd - 0.5 * a_mg
+                                    if (alpha >= BB["corr_min"]
+                                            and a_hat < BB["rho_max"]):
+                                        rho[c] = a_hat
+                        if it == 0 or due:
+                            x0 = [x.clone() for x in xs]
+                        if due:
+                            yhat0 = [yh.clone() for yh in yhat]
+                    wsum = sum(rho)
+                    znew = sum(ys[c] + rho[c] * xs[c] for c in range(K)) / wsum
+                    dual_r.append(float(torch.norm(z - znew)) / n)
+                    for c in range(K):
+                        ys[c] = ys[c] + rho[c] * (xs[c] - znew)
+                    primal_r.append(
+                        sum(float(torch.norm(xs[c] - znew)) for c in range(K))
+                        / (K * n)
+                    )
+                    rho_r.append(sum(rho) / K)
+                    z = znew
+                acc.append(accuracy())
+            rho_store[gid] = list(rho)
+
+    return dict(acc=acc, dual=dual_r, primal=primal_r, mean_rho=rho_r)
 
 
 # ----------------------------------------------------------- framework side
 
 
-def run_framework(src) -> list:
+def run_framework(kind, src, batch, nloop, nadmm, strategy, bb, group_slice):
     from federated_pytorch_test_tpu.engine import Trainer, get_preset
 
+    preset = {
+        ("net", "fedavg"): "fedavg",
+        ("net", "admm"): "admm",
+        ("resnet18", "fedavg"): "fedavg_resnet",
+        ("resnet18", "admm"): "admm_resnet",
+    }[(kind, strategy)]
     cfg = get_preset(
-        "fedavg",
-        model="net",
-        batch=BATCH,
-        nloop=NLOOP,
-        nadmm=NADMM,
+        preset,
+        model=kind if kind == "net" else "resnet18",
+        batch=batch,
+        nloop=nloop,
+        nadmm=nadmm,
         biased_input=False,
         reg_mode="none",
         check_results=True,
+        bb_update=bb,
+        admm_rho0=ADMM_RHO0,
         seed=SEED,
         eval_batch=N_TEST,
     )
     tr = Trainer(cfg, verbose=False, source=src)
-    series = [list(np.asarray(tr.evaluate(), float))]
+    if group_slice:
+        tr.group_order = tr.group_order[:group_slice]
+    acc = [list(np.asarray(tr.evaluate(), float))]
     rec = tr.run()
-    series += [r["value"] for r in rec.series["test_accuracy"]]
-    return series
+    acc += [r["value"] for r in rec.series["test_accuracy"]]
+    out = dict(
+        acc=acc,
+        dual=[r["value"] for r in rec.series.get("dual_residual", [])],
+        primal=[r["value"] for r in rec.series.get("primal_residual", [])],
+        mean_rho=[r["value"] for r in rec.series.get("mean_rho", [])],
+    )
+    return out
+
+
+# ------------------------------------------------------------------ compare
+
+
+def _mean_curve(acc_series):
+    return [float(np.mean(a)) for a in acc_series]
+
+
+def _log_ratio_band(fw, ref):
+    """Median |log10(fw/ref)| over aligned, strictly-positive rounds."""
+    m = min(len(fw), len(ref))
+    pairs = [
+        (f, r)
+        for f, r in zip(fw[:m], ref[:m])
+        if f and r and f > 0 and r > 0
+    ]
+    if not pairs:
+        return None
+    return float(
+        np.median([abs(np.log10(f / r)) for f, r in pairs])
+    )
+
+
+def compare(fw, ref, strategy, acc_band=0.05):
+    """`acc_band` is the final-accuracy tolerance. The simple configs run
+    the full schedule to the ~0.78 plateau, where 0.05 is meaningful; the
+    REDUCED resnet configs train 6 rounds from near-chance, where the
+    accuracy signal is shuffle noise (both sides sit at 0.10-0.25) — they
+    get a wider band and their real oracle is the residual trajectories.
+    """
+    fa, ra = _mean_curve(fw["acc"]), _mean_curve(ref["acc"])
+    m = min(len(fa), len(ra))
+    diffs = [abs(f - r) for f, r in zip(fa[:m], ra[:m])]
+    out = {
+        "final_acc": {"framework": fa[-1], "reference": ra[-1]},
+        "final_acc_diff": round(abs(fa[-1] - ra[-1]), 4),
+        "mean_acc_diff": round(float(np.mean(diffs)), 4),
+        "acc_band": acc_band,
+        "acc_final_within_band": abs(fa[-1] - ra[-1]) <= acc_band,
+        "acc_mean_within_0.06": float(np.mean(diffs)) <= 0.06,
+        "dual_log10_median": _log_ratio_band(fw["dual"], ref["dual"]),
+    }
+    if out["dual_log10_median"] is not None:
+        out["dual_within_half_order"] = out["dual_log10_median"] <= 0.5
+    if strategy == "admm":
+        out["primal_log10_median"] = _log_ratio_band(
+            fw["primal"], ref["primal"]
+        )
+        if out["primal_log10_median"] is not None:
+            out["primal_within_half_order"] = (
+                out["primal_log10_median"] <= 0.5
+            )
+        if fw["mean_rho"] and ref["mean_rho"]:
+            ratio = fw["mean_rho"][-1] / ref["mean_rho"][-1]
+            out["final_rho_ratio"] = round(float(ratio), 3)
+            out["rho_ratio_within_2x"] = 0.5 <= ratio <= 2.0
+    return out
+
+
+CONFIGS = {
+    "fedavg_simple": dict(kind="net", strategy="fedavg", bb=False,
+                          nloop=NLOOP_SIMPLE, nadmm=3, group_slice=None,
+                          acc_band=0.05, **SIMPLE),
+    "admm_simple": dict(kind="net", strategy="admm", bb=True,
+                        nloop=NLOOP_SIMPLE, nadmm=5, group_slice=None,
+                        acc_band=0.05, **SIMPLE),
+    "fedavg_resnet": dict(kind="resnet18", strategy="fedavg", bb=False,
+                          nloop=1, nadmm=3, group_slice=2, acc_band=0.10,
+                          **RESNET),
+    "admm_resnet": dict(kind="resnet18", strategy="admm", bb=False,
+                        nloop=1, nadmm=3, group_slice=2, acc_band=0.10,
+                        **RESNET),
+}
+
+PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "convergence_parity.json")
 
 
 def main():
-    src = synthetic()
+    name = sys.argv[1] if len(sys.argv) > 1 else None
+    if name not in CONFIGS:
+        sys.exit(f"usage: convergence_parity.py {{{'|'.join(CONFIGS)}}}")
+    if not os.path.isdir(REFERENCE_SRC):
+        sys.exit(f"reference checkout not found at {REFERENCE_SRC}")
+    c = CONFIGS[name]
+    src = synthetic(c["n_train"])
+
     t0 = time.time()
-    fw = run_framework(src)
+    fw = run_framework(c["kind"], src, c["batch"], c["nloop"], c["nadmm"],
+                       c["strategy"], c["bb"], c["group_slice"])
     t_fw = time.time() - t0
     t0 = time.time()
-    ref = run_reference(src)
+    ref = run_reference(c["kind"], src, c["batch"], c["nloop"], c["nadmm"],
+                        c["strategy"], c["bb"], c["group_slice"])
     t_ref = time.time() - t0
 
-    out = {
-        "workload": (
-            f"{K}-client simple-CNN partial-param FedAvg on deterministic "
-            f"synthetic CIFAR ({N_TRAIN} train / {N_TEST} test), batch "
-            f"{BATCH}, nloop={NLOOP}, nadmm={NADMM}, L-BFGS(10,4,ls,batch)"
-        ),
-        "reference": {"acc": ref, "seconds": round(t_ref, 1)},
-        "framework": {"acc": fw, "seconds": round(t_fw, 1)},
-        "final_mean_acc": {
-            "reference": round(float(np.mean(ref[-1])), 4),
-            "framework": round(float(np.mean(fw[-1])), 4),
+    result = {
+        "config": {k: v for k, v in c.items()},
+        "hardness": HARDNESS,
+        "seconds": {"framework": round(t_fw, 1), "reference": round(t_ref, 1)},
+        "curves": {
+            "framework": {
+                "acc_mean": _mean_curve(fw["acc"]),
+                "dual": fw["dual"], "primal": fw["primal"],
+                "mean_rho": fw["mean_rho"],
+            },
+            "reference": {
+                "acc_mean": _mean_curve(ref["acc"]),
+                "dual": ref["dual"], "primal": ref["primal"],
+                "mean_rho": ref["mean_rho"],
+            },
         },
+        "verdict": compare(fw, ref, c["strategy"], c["acc_band"]),
     }
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "convergence_parity.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps(out["final_mean_acc"]))
+
+    merged = {}
+    if os.path.exists(PATH):
+        try:
+            merged = json.load(open(PATH))
+        except Exception:
+            merged = {}
+    if "workload" not in merged or "rows" in merged:
+        merged = {
+            "workload": (
+                f"{K}-client partial-param consensus on a DISCRIMINATING "
+                f"synthetic set (class overlap {HARDNESS['overlap']}, label "
+                f"noise {HARDNESS['label_noise']} -> ~0.78 accuracy "
+                "ceiling); torch reference drives the imported LBFGSNew"
+            ),
+        }
+    merged[name] = result
+    with open(PATH, "w") as f:
+        json.dump(merged, f, indent=1)
+    print(json.dumps({name: result["verdict"]}))
 
 
 if __name__ == "__main__":

@@ -137,6 +137,8 @@ def synthetic_cifar(
     num_classes: int = 10,
     seed: int = 0,
     noise: float = 35.0,
+    overlap: float = 0.0,
+    label_noise: float = 0.0,
 ) -> DataSource:
     """Deterministic learnable stand-in with CIFAR shapes.
 
@@ -144,16 +146,38 @@ def synthetic_cifar(
     `clip(prototype + noise)`. A small CNN separates the classes well above
     chance within one epoch, so convergence smoke tests (SURVEY.md §4d)
     remain meaningful without the real archive.
+
+    The default set is nearly separable — every healthy configuration
+    reaches ~1.0, which cannot DISCRIMINATE a correct implementation from
+    a subtly wrong one. For a discriminating convergence oracle
+    (benchmarks/convergence_parity.py) use:
+
+    * `overlap` in [0, 1): blends each class prototype with its
+      neighbour's, shrinking class margins;
+    * `label_noise` in [0, 1): flips that fraction of labels (train AND
+      test) to a uniformly random other class, capping achievable test
+      accuracy at ~(1 - p) + p/C — e.g. 0.25 caps it at ~0.78, so the
+      accuracy curve plateaus below ceiling and has discriminating shape.
+
+    Both are deterministic in `seed`.
     """
     rng = np.random.default_rng(seed)
     # low-frequency prototypes: upsampled 4x4 color patterns
     proto_small = rng.uniform(60, 195, size=(num_classes, 4, 4, 3))
     proto = proto_small.repeat(8, axis=1).repeat(8, axis=2)  # [C,32,32,3]
+    if overlap:
+        proto = (1.0 - overlap) * proto + overlap * np.roll(proto, 1, axis=0)
 
     def draw(n: int, r: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
         labels = r.integers(0, num_classes, size=n).astype(np.int32)
         eps = r.normal(0.0, noise, size=(n, 32, 32, 3))
         images = np.clip(proto[labels] + eps, 0, 255).astype(np.uint8)
+        if label_noise:
+            flip = r.random(n) < label_noise
+            shift = r.integers(1, num_classes, size=n).astype(np.int32)
+            labels = np.where(
+                flip, (labels + shift) % num_classes, labels
+            ).astype(np.int32)
         return images, labels
 
     tr_i, tr_l = draw(n_train, rng)

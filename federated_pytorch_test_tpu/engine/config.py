@@ -97,6 +97,18 @@ class ExperimentConfig:
     # helper, src/consensus_admm_trio_resnet.py:416-419)
     z_soft_threshold: float = 0.0
 
+    # HBM budget for the TRAINING data (MiB). None = the whole dataset is
+    # put on device up front (fastest; the default — CIFAR is 150 MB).
+    # When set and the dataset exceeds it, the trainer STREAMS: data stays
+    # host-side, the native PrefetchBatcher (data/native.py) assembles
+    # lockstep minibatch chunks per client, and each chunk's device_put
+    # double-buffers against the previous chunk's jitted compute — the
+    # path for datasets that do not fit HBM.
+    hbm_data_budget_mb: int | None = None
+    # lockstep minibatches per streamed chunk (one jitted scan per chunk;
+    # larger chunks amortize dispatch, smaller ones bound staging memory)
+    stream_chunk_steps: int = 8
+
     # write a jax.profiler trace of each epoch here (TPU/host timelines)
     profile_dir: str | None = None
 

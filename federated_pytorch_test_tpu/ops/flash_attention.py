@@ -144,6 +144,36 @@ def _run_unless_skipped(causal, keep_pred, compute):
         compute()
 
 
+def _online_softmax_update(sc, m, l, o, v, prec, guard_masked_rows: bool):
+    """Fold one score tile into the (m, l, o) online-softmax accumulators.
+
+    The single copy of the numerically delicate update, shared by the
+    rectangular and triangular forward kernels. `guard_masked_rows` zeroes
+    rows whose running max is still _NEG_BIG — they have seen only masked
+    scores (sc - m_new == 0 there, NOT -inf), possible for non-tile-
+    aligned offsets in the OFFSET path; the ALIGNED triangular path never
+    produces such rows (every causal row's diagonal tile holds its own
+    key), so it skips the guard. The threshold assumes real scores
+    satisfy |score| << 5e29 — true for any f32 q,k.
+    """
+    m_new = jnp.maximum(m, jnp.max(sc, axis=1))
+    p = jnp.exp(sc - m_new[:, None])
+    if guard_masked_rows:
+        p = jnp.where((m_new > _NEG_BIG * 0.5)[:, None], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=1)
+    o_new = o * corr[:, None] + _dot(p, v, _LF, prec)
+    return m_new, l_new, o_new
+
+
+def _p_ds_tile(q, k, v, do, lse, delta, qpos0, kpos0, causal, scale, prec):
+    """Recompute P and dS = P * (dP - delta) for one tile — the shared
+    backward-pass core (flash-2: dP = dO V^T)."""
+    p = _p_block(q, k, lse, qpos0, kpos0, causal, scale, prec)
+    dp = _dot(do, v, _LL, prec)
+    return p, p * (dp - delta[:, None])
+
+
 # ---------------------------------------------------------------------------
 # causal block-skip predicates and DMA-elision index maps, in terms of the
 # global offsets. A streamed block is USEFUL iff its tile overlaps the
@@ -225,13 +255,11 @@ def _fwd_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # serves every pair, and aligned diagonals guarantee every row sees
     # its own key, so no fully-masked-row guard is needed here
     sc = _causal_mask(sc, i * bq, j * bq)
-    m = m_acc[:, 0]
-    l = l_acc[:, 0]
-    m_new = jnp.maximum(m, jnp.max(sc, axis=1))
-    p = jnp.exp(sc - m_new[:, None])
-    corr = jnp.exp(m - m_new)
-    l_new = l * corr + jnp.sum(p, axis=1)
-    o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v_ref[0], _LF, prec)
+    m_new, l_new, o_new = _online_softmax_update(
+        sc, m_acc[:, 0], l_acc[:, 0], o_acc[:], v_ref[0], prec,
+        guard_masked_rows=False,
+    )
+    o_acc[:] = o_new
     m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
     l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
@@ -254,10 +282,8 @@ def _bwd_dq_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     k = k_ref[0]
-    p = _p_block(q_ref[0], k, lse_ref[0][:, 0], i * bq, j * bq,
-                 True, scale, prec)
-    dp = _dot(do_ref[0], v_ref[0], _LL, prec)
-    ds = p * (dp - delta_ref[0][:, 0][:, None])
+    _, ds = _p_ds_tile(q_ref[0], k, v_ref[0], do_ref[0], lse_ref[0][:, 0],
+                       delta_ref[0][:, 0], i * bq, j * bq, True, scale, prec)
     dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF, prec)
 
     @pl.when(j == i)
@@ -279,11 +305,9 @@ def _bwd_dkv_kernel_tri(jtab, itab, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     q = q_ref[0]
     do = do_ref[0]
-    p = _p_block(q, k_ref[0], lse_ref[0][:, 0], i * bq, j * bq,
-                 True, scale, prec)
+    p, ds = _p_ds_tile(q, k_ref[0], v_ref[0], do, lse_ref[0][:, 0],
+                       delta_ref[0][:, 0], i * bq, j * bq, True, scale, prec)
     dv_acc[:] = dv_acc[:] + _dot(p, do, _FF, prec)
-    dp = _dot(do, v_ref[0], _LL, prec)
-    ds = p * (dp - delta_ref[0][:, 0][:, None])
     dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF, prec)
 
     @pl.when(i == nq - 1)
@@ -306,25 +330,16 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     def compute():
         q = q_ref[0] * scale  # [BQ, D]
-        k = k_ref[0]  # [BK, D]
-        v = v_ref[0]
-        sc = _dot(q, k, _LL, prec)  # [BQ, BK]
+        sc = _dot(q, k_ref[0], _LL, prec)  # [BQ, BK]
         if causal:
             sc = _causal_mask(sc, off_ref[0] + qi * bq, off_ref[1] + j * bk)
-        m = m_acc[:, 0]
-        l = l_acc[:, 0]
-        m_new = jnp.maximum(m, jnp.max(sc, axis=1))
-        p = jnp.exp(sc - m_new[:, None])
-        if causal:
-            # rows whose running max is still _NEG_BIG have seen only
-            # masked scores (sc - m_new == 0 there, NOT -inf): zero them
-            # so partially-masked tiles of non-aligned offsets stay exact.
-            # The threshold assumes real scores satisfy |score| << 5e29 —
-            # true for any f32 q,k (|q||k|*D would have to reach 1e29).
-            p = jnp.where((m_new > _NEG_BIG * 0.5)[:, None], p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        o_acc[:] = o_acc[:] * corr[:, None] + _dot(p, v, _LF, prec)
+        # masked-row guard on: non-aligned ring offsets can produce tiles
+        # whose kept rows still see no key (see _online_softmax_update)
+        m_new, l_new, o_new = _online_softmax_update(
+            sc, m_acc[:, 0], l_acc[:, 0], o_acc[:], v_ref[0], prec,
+            guard_masked_rows=causal,
+        )
+        o_acc[:] = o_new
         m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
         l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
 
@@ -353,14 +368,11 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def compute():
-        do = do_ref[0]
-        delta = delta_ref[0][:, 0]
         k = k_ref[0]
-        p = _p_block(q_ref[0], k, lse_ref[0][:, 0],
-                     off_ref[0] + qi * bq, off_ref[1] + j * bk,
-                     causal, scale, prec)
-        dp = _dot(do, v_ref[0], _LL, prec)
-        ds = p * (dp - delta[:, None])
+        _, ds = _p_ds_tile(q_ref[0], k, v_ref[0], do_ref[0],
+                           lse_ref[0][:, 0], delta_ref[0][:, 0],
+                           off_ref[0] + qi * bq, off_ref[1] + j * bk,
+                           causal, scale, prec)
         dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF, prec)
 
     _run_unless_skipped(causal, _kv_keep(off_ref, qi, j, bq, bk), compute)
@@ -385,13 +397,11 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def compute():
         q = q_ref[0]
         do = do_ref[0]
-        delta = delta_ref[0][:, 0]
-        p = _p_block(q, k_ref[0], lse_ref[0][:, 0],
-                     off_ref[0] + i * bq, off_ref[1] + ki * bk,
-                     causal, scale, prec)
+        p, ds = _p_ds_tile(q, k_ref[0], v_ref[0], do, lse_ref[0][:, 0],
+                           delta_ref[0][:, 0],
+                           off_ref[0] + i * bq, off_ref[1] + ki * bk,
+                           causal, scale, prec)
         dv_acc[:] = dv_acc[:] + _dot(p, do, _FF, prec)
-        dp = _dot(do, v_ref[0], _LL, prec)
-        ds = p * (dp - delta[:, None])
         dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF, prec)
 
     _run_unless_skipped(causal, _q_keep(off_ref, ki, i, bq, bk), compute)

@@ -15,6 +15,7 @@ from federated_pytorch_test_tpu.parallel.collectives import (
 )
 from federated_pytorch_test_tpu.parallel.diagnostics import group_distances
 from federated_pytorch_test_tpu.parallel.ring import (
+    mark_varying,
     SEQ_AXIS,
     dense_attention,
     ring_attention,
@@ -38,6 +39,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "mark_varying",
     "CLIENT_AXIS",
     "SEQ_AXIS",
     "all_clients",

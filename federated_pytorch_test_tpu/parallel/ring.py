@@ -41,13 +41,23 @@ _NEG_BIG = -1e30  # large-negative instead of -inf: keeps exp() at exact 0
 # without NaNs from (-inf) - (-inf) in fully-masked blocks
 
 
-def _pvary(x, axis_name):
-    """Mark `x` as varying over `axis_name` (no-op on older JAX)."""
+def mark_varying(x, axis_name):
+    """Mark `x` as varying over `axis_name` (no-op on older JAX).
+
+    Used for constant-initialized accumulators that a loop will overwrite
+    with varying values, and for replicated operands (e.g. the consensus
+    vector z) that are closed over by a `lax.while_loop` — JAX's vma
+    fixpoint re-applies recorded pvary insertions when loop carries get
+    promoted, which errors on an unvarying closed-over constant.
+    """
     if hasattr(lax, "pcast"):
         return lax.pcast(x, (axis_name,), to="varying")
     if hasattr(lax, "pvary"):  # pre-pcast JAX
         return lax.pvary(x, (axis_name,))
     return x
+
+
+_pvary = mark_varying  # internal alias used below
 
 
 def dense_attention(

@@ -157,3 +157,36 @@ def test_synthetic_learnable_separation():
     )
     acc = (pred == src.test_labels).mean()
     assert acc > 0.5
+
+
+def test_synthetic_hardness_knobs():
+    # the discriminating-oracle knobs (benchmarks/convergence_parity.py):
+    # label_noise flips ~that fraction of labels deterministically, and
+    # overlap blends neighbouring class prototypes
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+
+    easy = synthetic_cifar(n_train=2000, n_test=10, seed=0)
+    hard = synthetic_cifar(
+        n_train=2000, n_test=10, seed=0, overlap=0.35, label_noise=0.25
+    )
+    # determinism
+    again = synthetic_cifar(
+        n_train=2000, n_test=10, seed=0, overlap=0.35, label_noise=0.25
+    )
+    np.testing.assert_array_equal(hard.train_images, again.train_images)
+    np.testing.assert_array_equal(hard.train_labels, again.train_labels)
+    # flipped fraction ~ label_noise (images drawn identically => same
+    # underlying class stream; only the labels move)
+    flipped = float(np.mean(hard.train_labels != easy.train_labels))
+    assert 0.18 <= flipped <= 0.32, flipped
+    # overlap pulls neighbouring prototypes together: the mean distance
+    # between adjacent class prototypes must shrink
+    def proto_gap(srcx):
+        # per-class mean image approximates the prototype
+        protos = np.stack([
+            srcx.train_images[srcx.train_labels == c].mean(axis=0)
+            for c in range(10)
+        ])
+        return float(np.mean(np.abs(protos - np.roll(protos, 1, axis=0))))
+
+    assert proto_gap(hard) < proto_gap(easy)
