@@ -219,8 +219,11 @@ class Trainer:
             # one-shot whole-model average before training
             # (reference src/no_consensus_trio.py:22,134-160)
             if jax.process_count() == 1:
-                # device-side mean: keeps the f32 reduction order (and so
-                # the resulting trajectory) bit-identical to prior runs
+                # device-side mean: no host round trip. NOTE: XLA's f32
+                # reduction order is its own — not guaranteed bitwise
+                # equal to the multi-process branch's host numpy mean
+                # (both are exact to ~1 ulp; runs comparing across the
+                # two branches should compare curves, not bits)
                 self.flat = self._put(
                     jnp.broadcast_to(
                         jnp.mean(self.flat, axis=0), self.flat.shape

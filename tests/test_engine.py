@@ -467,21 +467,29 @@ def test_streaming_data_path_trains():
     # resides on device: per-client PrefetchBatchers assemble lockstep
     # chunks, double-buffered against the jitted scan
     # (trainer._run_stream_epoch). Must train like the resident path.
+    src = synthetic_cifar(n_train=360, n_test=60)  # 120/client
     cfg = tiny(
         "fedavg", model="net", nadmm=2,
-        hbm_data_budget_mb=0,  # force streaming (dataset ~0.7 MB > 0)
-        stream_chunk_steps=1,  # 2 minibatches/epoch -> 2 chunks: exercises
-                               # the chunked loop AND the tail chunk
+        hbm_data_budget_mb=0,  # force streaming (dataset ~1 MB > 0)
+        stream_chunk_steps=2,  # 3 minibatches/epoch -> chunks of 2 and 1:
+                               # exercises the chunked loop AND the
+                               # smaller TAIL chunk (its own compile)
     )
-    tr = Trainer(cfg, verbose=False, source=SRC)
+    tr = Trainer(cfg, verbose=False, source=src)
     assert tr._stream and tr.shard_imgs is None
     assert len(tr._batchers) == 3
     tr.group_order = tr.group_order[:2]
     rec = tr.run()
 
     losses = rec.series["train_loss"]
-    # 240/3 = 80/client, batch 40 -> 2 lockstep minibatches per epoch
+    # 360/3 = 120/client, batch 40 -> 3 lockstep minibatches per epoch
     assert len(losses[0]["value"]) == 3
+    per_epoch = [
+        e for e in losses
+        if e["nloop"] == 0 and e["group"] == tr.group_order[0]
+        and e["nadmm"] == 0
+    ]
+    assert len(per_epoch) == 3  # all 3 steps (2-chunk + tail) recorded
     first, last = np.mean(losses[0]["value"]), np.mean(losses[-1]["value"])
     assert np.isfinite(last) and last < first
     # FedAvg sync still holds through the streamed epochs
