@@ -49,7 +49,7 @@ def main() -> None:
 
     assert jax.default_backend() == "tpu", jax.default_backend()
 
-    over = {"nloop": args.nloop} if args.nloop else {}
+    over = {"nloop": args.nloop} if args.nloop is not None else {}
     if args.stream:
         over.update(hbm_data_budget_mb=0, stream_chunk_steps=8)
     cfg = get_preset(args.preset, **over)
@@ -66,7 +66,7 @@ def main() -> None:
     ]
     out = {
         "experiment": f"full {args.preset} preset (complete reference schedule)"
-        + (f" at nloop={args.nloop}" if args.nloop else "")
+        + (f" at nloop={args.nloop}" if args.nloop is not None else "")
         + (" via the streaming data path" if args.stream else ""),
         "nloop": cfg.nloop,
         "backend": "tpu",
