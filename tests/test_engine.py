@@ -500,3 +500,23 @@ def test_streaming_data_path_trains():
         assert np.abs(blk - blk[:1]).max() == 0.0
     for b in tr._batchers:
         b.close()
+
+
+def test_streaming_rejects_incompatible_modes():
+    # the streaming path cannot honor per-batch eval (resident-only) or
+    # exact-replay checkpointing (batcher stream positions are not
+    # checkpointed) — both must fail LOUDLY at construction, not diverge
+    # silently mid-run
+    base = dict(model="net", hbm_data_budget_mb=0)
+    with pytest.raises(NotImplementedError, match="eval_every_batch"):
+        Trainer(
+            tiny("fedavg", check_results=True, eval_every_batch=True, **base),
+            verbose=False,
+            source=SRC,
+        )
+    with pytest.raises(NotImplementedError, match="checkpoint"):
+        Trainer(
+            tiny("fedavg", save_model=True, **base),
+            verbose=False,
+            source=SRC,
+        )
