@@ -142,6 +142,11 @@ class ExperimentConfig:
     eval_batch: int = 500
     checkpoint_dir: str = "./checkpoints"
     max_devices: int | None = None
+    # train only the FIRST N groups of the (possibly shuffled) partition
+    # order — the reduced-schedule knob every smoke run, benchmark, and
+    # parity config wants (each outer loop still visits those N groups
+    # with the full consensus/eval machinery). None = all groups.
+    max_groups: int | None = None
 
     def __post_init__(self):
         if self.compute_dtype not in ("float32", "bfloat16"):
@@ -164,6 +169,8 @@ class ExperimentConfig:
                 f"reg_mode must be 'active_linear', 'first_linear' or "
                 f"'none', got {self.reg_mode!r}"
             )
+        if self.max_groups is not None and self.max_groups < 1:
+            raise ValueError(f"max_groups must be >= 1, got {self.max_groups}")
 
     def lbfgs_config(self) -> LBFGSConfig:
         return LBFGSConfig(

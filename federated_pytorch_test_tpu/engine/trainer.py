@@ -124,6 +124,8 @@ class Trainer:
                 # np.seed(0) permutation, reused for every outer loop
                 rng = np.random.RandomState(0)
                 order = list(rng.permutation(self.model_partition.num_groups))
+            if cfg.max_groups is not None:
+                order = order[: cfg.max_groups]
             self.group_order = [int(g) for g in order]
 
         # device placement. Single-process, `_put` is jax.device_put; on a

@@ -520,3 +520,16 @@ def test_streaming_rejects_incompatible_modes():
             verbose=False,
             source=SRC,
         )
+
+
+def test_max_groups_limits_partition_order():
+    # the reduced-schedule knob: train only the first N groups of the
+    # (possibly shuffled) order — also reachable as --max-groups via the
+    # auto-generated CLI
+    cfg = tiny("fedavg", model="net", nadmm=1, max_groups=2)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    assert tr.group_order == [2, 0]  # first 2 of train_order [2,0,1,3,4]
+    rec = tr.run()
+    assert len(rec.series["dual_residual"]) == 2  # one round per group
+    with pytest.raises(ValueError, match="max_groups"):
+        tiny("fedavg", max_groups=0)
