@@ -78,6 +78,12 @@ class Trainer:
                 synthetic_n_test=cfg.synthetic_n_test,
             )
         self.fed = make_federated(source, cfg.n_clients, biased=cfg.biased_input)
+        if self.fed.steps_per_epoch(cfg.batch) == 0:
+            raise ValueError(
+                f"batch={cfg.batch} exceeds the per-client shard size "
+                f"({self.fed.shard_size}): zero lockstep steps fit in an "
+                "epoch — shrink the batch"
+            )
         self.mesh = mesh if mesh is not None else largest_feasible_mesh(
             cfg.n_clients, cfg.max_devices
         )
@@ -406,12 +412,16 @@ class Trainer:
         """
         cfg = self.cfg
         k = cfg.n_clients
-        s_total = self.fed.shard_size // cfg.batch
+        s_total = self.fed.steps_per_epoch(cfg.batch)  # > 0: checked at init
         chunk = max(1, min(cfg.stream_chunk_steps, s_total))
         sh = NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS))
+        sample_shape = tuple(self.fed.train_images.shape[2:])
 
         def assemble(n_steps):
-            imgs = np.empty((n_steps, k, cfg.batch, 32, 32, 3), np.uint8)
+            imgs = np.empty(
+                (n_steps, k, cfg.batch) + sample_shape,
+                self.fed.train_images.dtype,
+            )
             labs = np.empty((n_steps, k, cfg.batch), np.int32)
             for s in range(n_steps):
                 for c in range(k):

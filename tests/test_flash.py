@@ -54,13 +54,18 @@ def test_flash_gradients_match_dense(causal):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_gradients_multiblock(causal):
-    # s=384 => 3 tiles: exercises cross-block accumulation and BOTH
-    # causal skip bounds in the backward kernels (which degenerate to a
-    # single iteration at s=128)
+    # s=384 with forced 128-row tiles => 3 tiles per axis: exercises
+    # cross-block accumulation and BOTH causal skip bounds in the backward
+    # kernels. The explicit block_q/block_k matter: the 512 default would
+    # resolve to ONE 384-row tile and the multi-tile init/flush paths of
+    # the triangular dq/dkv kernels would never run.
     q, k, v = _qkv(b=1, s=384, h=1, d=16, seed=5)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+            ** 2
+        )
 
     def loss_dense(q, k, v):
         return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
@@ -81,6 +86,28 @@ def test_flash_2048_tokens_match_dense():
     ref = dense_attention(q, k, v, causal=True)
     out = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-5, atol=3e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_multiblock_default_tiles(causal):
+    # s=1024 with the DEFAULT 512 tiles => 2x2 triangular tile grid:
+    # gradient coverage for the production tile shape (the forced-128
+    # test above covers 3x3; the s=2048 test is forward-only)
+    q, k, v = _qkv(b=1, s=1024, h=1, d=16, seed=11)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4,
+            err_msg=f"d{name}",
+        )
 
 
 def test_flash_custom_scale_and_jit():
