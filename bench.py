@@ -2,7 +2,7 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
-   "mfu": ..., "achieved_tflops": ..., "roofline": {...}}
+   "mfu": ..., "achieved_tflops": ..., "roofline": {...}, "sweep": [...]}
 
 The hot loop is the jitted sharded epoch function — every client's
 stochastic L-BFGS step (up to 4 inner iterations, Armijo line-search
@@ -28,6 +28,15 @@ the measured wall-clock and the chip's peaks:
   hbm_util          = achieved bytes/s / peak HBM bandwidth
   arithmetic intensity vs the ridge point says which wall the workload
   is against — see BASELINE.md's roofline note.
+
+The `sweep` block (disable with BENCH_SWEEP=0) answers "can the chip
+bind at all on this workload family?": the flagship config is inherently
+overhead-bound (batch-32 CIFAR, BLAS1-heavy inner solver — inherited
+from the reference, src/federated_trio_resnet.py:17), so the sweep
+scales the two levers BASELINE.md names — batch size and model width —
+and reports MFU per row. Rows: resnet18 at batch 32/128/512 (f32),
+resnet18 batch-512 bf16, and net2 (the 2.5M-param CNN,
+reference src/simple_models.py:83) at its reference batch 512.
 """
 
 from __future__ import annotations
@@ -55,13 +64,16 @@ def _peaks(device_kind: str):
     return None, None
 
 
-def main() -> None:
-    bench_device = os.environ.get("BENCH_DEVICE", "")
-    if bench_device == "cpu":
-        from federated_pytorch_test_tpu.utils import force_host_cpu
+def _measure(preset: str, model: str | None, batch: int, steps: int,
+             dtype: str, peak_tflops, peak_gbps):
+    """Build one config's epoch program, time it, return the row dict.
 
-        force_host_cpu()
-    import jax
+    Timing protocol (see memory: the tunneled chip lies to
+    block_until_ready): `steps` lockstep minibatches inside ONE jitted
+    scan amortize the ~0.1 s flat dispatch latency; a device->host
+    scalar fetch is the completion barrier; best-of-3 minimum because
+    the chip is shared.
+    """
     import jax.numpy as jnp
     import numpy as np
 
@@ -69,20 +81,14 @@ def main() -> None:
     from federated_pytorch_test_tpu.engine import Trainer, get_preset
 
     k = 3
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-
-    # synthetic CIFAR-shaped data (identical compute to the real archive)
     src = synthetic_cifar(n_train=k * batch * max(steps, 8), n_test=64)
-    cfg = get_preset(
-        "fedavg_resnet",
-        n_clients=k,
-        batch=batch,
-        check_results=False,
-        # convs/matmuls in bf16 on the MXU when BENCH_DTYPE=bfloat16;
-        # loss, norms and the L-BFGS math stay f32 either way
-        compute_dtype=os.environ.get("BENCH_DTYPE", "float32"),
+    over = dict(
+        n_clients=k, batch=batch, check_results=False, compute_dtype=dtype,
+        max_scan_steps=None,  # the timed scan IS one call; steps stays small
     )
+    if model is not None:
+        over["model"] = model
+    cfg = get_preset(preset, **over)
     tr = Trainer(cfg, verbose=False, source=src)
     gid = tr.group_order[0]
     epoch_fn, _, init_fn = tr._fns(gid)
@@ -99,11 +105,8 @@ def main() -> None:
 
     idx = tr._epoch_indices(0, gid, 0, 0)[:steps]
 
-    # exact FLOP / HBM-byte counts of the compiled epoch program (XLA's
-    # cost model over the optimized HLO — includes every line-search
-    # probe and all L-BFGS linear algebra, not just the model matmuls).
-    # The AOT executable then SERVES the warmup/timed calls below, so the
-    # epoch program is compiled exactly once per run.
+    # exact FLOP / HBM-byte counts of the compiled epoch program; the
+    # AOT executable then serves the timed calls (one compile per row)
     flops = hbm_bytes = None
     try:
         compiled = epoch_fn.lower(
@@ -118,18 +121,11 @@ def main() -> None:
     except Exception:
         pass
 
-    # warmup / compile (same scan length as the timed run — scan length is
-    # static, so a shorter warmup would compile a second program).
-    # Synchronization is a SCALAR FETCH, not block_until_ready: on the
-    # remote-tunnel PJRT runtime block_until_ready returns at dispatch-ack,
-    # so only a device->host read is a true completion barrier. The timed
-    # call's inputs differ from the warmup's (flat/lstate/stats are
-    # threaded through), so no result caching can serve it.
+    # warmup at the timed scan length (scan length is static in the
+    # program); scalar fetch = the only true completion barrier here
     flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
     float(jnp.sum(flat[:, 0]))
 
-    # best of 3: the tunneled chip is shared, so single-shot timings can
-    # absorb other tenants' work — the minimum is the machine's number
     dt = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
@@ -138,18 +134,56 @@ def main() -> None:
         dt = min(dt, time.perf_counter() - t0)
 
     n_samples = steps * k * batch
-    sps = n_samples / dt
+    row = {
+        "model": cfg.model,
+        "batch": batch,
+        "dtype": dtype,
+        "steps": steps,
+        "samples_per_sec": round(n_samples / dt, 2),
+        "epoch_time_s": round(dt, 4),
+    }
+    if flops:
+        row["achieved_tflops"] = round(flops / dt / 1e12, 3)
+        if peak_tflops:
+            row["mfu"] = round(flops / dt / 1e12 / peak_tflops, 4)
+    if hbm_bytes:
+        row["achieved_hbm_gbps"] = round(hbm_bytes / dt / 1e9, 1)
+        if peak_gbps:
+            row["hbm_util"] = round(hbm_bytes / dt / 1e9 / peak_gbps, 4)
+    if flops and hbm_bytes:
+        row["arithmetic_intensity"] = round(flops / hbm_bytes, 1)
 
     # closure-evaluation accounting (the reference's one built-in counter,
     # src/lbfgsnew.py:508-510): value_and_grad evals per optimizer step,
-    # cumulative in the threaded L-BFGS state
-    func_evals = None
+    # cumulative in the threaded L-BFGS state over 1 warmup + 3 timed runs
     try:
+        import jax
+
         fe = np.asarray(jax.tree.leaves(lstate.func_evals)[0]).reshape(-1)
-        # state was threaded through 1 warmup + 3 timed epochs of `steps`
-        func_evals = float(fe.mean()) / (4 * steps)
+        row["mean_func_evals_per_step"] = round(float(fe.mean()) / (4 * steps), 2)
     except Exception:
         pass
+    return row
+
+
+def main() -> None:
+    bench_device = os.environ.get("BENCH_DEVICE", "")
+    if bench_device == "cpu":
+        from federated_pytorch_test_tpu.utils import force_host_cpu
+
+        force_host_cpu()
+    import jax
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
+
+    device_kind = jax.devices()[0].device_kind
+    peak_tflops, peak_gbps = _peaks(device_kind)
+
+    # ---- the flagship metric (reference workload, like for like) ----
+    flag = _measure("fedavg_resnet", None, batch, steps, dtype,
+                    peak_tflops, peak_gbps)
 
     ref_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -165,51 +199,67 @@ def main() -> None:
             ref = json.load(f)
         ref_sps = ref.get("samples_per_sec")
         if ref_sps:
-            vs_baseline = sps / ref_sps
+            vs_baseline = flag["samples_per_sec"] / ref_sps
 
     out = {
         "metric": "fedavg_resnet18_3client_lbfgs_train_throughput",
-        "value": round(sps, 2),
+        "value": flag["samples_per_sec"],
         "unit": "samples/sec",
         "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
         "batch": batch,
-        "n_clients": k,
-        "dtype": cfg.compute_dtype,
+        "n_clients": 3,
+        "dtype": dtype,
     }
+    if "achieved_tflops" in flag:
+        out["achieved_tflops"] = flag["achieved_tflops"]
+    if "mfu" in flag:
+        out["mfu"] = flag["mfu"]
+    roof = {
+        "device": device_kind,
+        "epoch_time_s": flag["epoch_time_s"],
+        "peak_tflops_bf16": peak_tflops,
+        "peak_hbm_gbps": peak_gbps,
+    }
+    for key in ("achieved_hbm_gbps", "hbm_util", "arithmetic_intensity",
+                "mean_func_evals_per_step"):
+        if key in flag:
+            roof[key] = flag[key]
+    if peak_tflops and peak_gbps:
+        roof["ridge_intensity"] = round(peak_tflops * 1e12 / (peak_gbps * 1e9), 1)
+        if "arithmetic_intensity" in flag:
+            roof["bound"] = (
+                "memory"
+                if flag["arithmetic_intensity"] < roof["ridge_intensity"]
+                else "compute"
+            )
+    out["roofline"] = roof
 
-    device_kind = jax.devices()[0].device_kind
-    peak_tflops, peak_gbps = _peaks(device_kind)
-    if flops:
-        achieved_tflops = flops / dt / 1e12
-        out["achieved_tflops"] = round(achieved_tflops, 3)
-        if peak_tflops:
-            out["mfu"] = round(achieved_tflops / peak_tflops, 4)
-    if hbm_bytes:
-        achieved_gbps = hbm_bytes / dt / 1e9
-        roof = {
-            "device": device_kind,
-            "epoch_time_s": round(dt, 4),
-            "flops_per_epoch": flops,
-            "hbm_bytes_per_epoch": hbm_bytes,
-            "achieved_hbm_gbps": round(achieved_gbps, 1),
-            "peak_tflops_bf16": peak_tflops,
-            "peak_hbm_gbps": peak_gbps,
-            "mean_func_evals_per_step": (
-                round(func_evals, 2) if func_evals else None
-            ),
-        }
-        if flops:
-            ai = flops / hbm_bytes
-            roof["arithmetic_intensity"] = round(ai, 1)
-            if peak_tflops and peak_gbps:
-                roof["ridge_intensity"] = round(
-                    peak_tflops * 1e12 / (peak_gbps * 1e9), 1
-                )
-                roof["hbm_util"] = round(achieved_gbps / peak_gbps, 4)
-                roof["bound"] = (
-                    "memory" if ai < roof["ridge_intensity"] else "compute"
-                )
-        out["roofline"] = roof
+    # ---- the utilization sweep: batch and model-size levers ----
+    # (round-2 VERDICT: "no row anywhere shows MFU climbing with batch or
+    # model size"). Step counts shrink as batch grows so each row stays a
+    # few seconds of device time while still amortizing dispatch.
+    if os.environ.get("BENCH_SWEEP", "1") != "0":
+        sweep_specs = [
+            ("fedavg_resnet", None, 32, 20, "float32"),
+            ("fedavg_resnet", None, 128, 10, "float32"),
+            ("fedavg_resnet", None, 512, 5, "float32"),
+            ("fedavg_resnet", None, 512, 5, "bfloat16"),
+            ("fedavg", "net2", 512, 5, "float32"),
+        ]
+        sweep = []
+        for spec in sweep_specs:
+            if spec[0] == "fedavg_resnet" and spec[2:] == (batch, steps, dtype):
+                # the flagship row, already measured
+                sweep.append(flag)
+                continue
+            try:
+                sweep.append(_measure(*spec, peak_tflops, peak_gbps))
+            except Exception as e:  # a failed row must not kill the bench
+                sweep.append({
+                    "model": spec[1] or "resnet18", "batch": spec[2],
+                    "dtype": spec[4], "error": f"{type(e).__name__}: {e}"[:200],
+                })
+        out["sweep"] = sweep
 
     print(json.dumps(out))
 

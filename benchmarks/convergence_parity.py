@@ -23,10 +23,13 @@ Four configurations, mirroring the reference driver pairs:
 
   fedavg_simple  Net, FULL schedule: nloop x 5 groups x nadmm=3
   admm_simple    Net, FULL schedule: nloop x 5 groups x nadmm=5, BB rho
-  fedavg_resnet  ResNet18, REDUCED: nloop=1, first 2 shuffled blocks,
-                 nadmm=3 (torch ResNet at full schedule is hours on this
-                 1-core host; 2 blocks exercise BN + block partition)
-  admm_resnet    ResNet18, REDUCED: same blocks, nadmm=3, fixed rho
+  fedavg_resnet  ResNet18, FULL 10-block shuffled schedule: nloop x 10
+                 groups x nadmm=3, on a shrunken shard (128/client) so
+                 the torch side stays a few hours, not days — both sides
+                 train well above chance, so the 0.05 accuracy band is
+                 as discriminating as the simple configs' (round-2
+                 VERDICT item 1)
+  admm_resnet    ResNet18, FULL schedule: same structure, fixed rho
 
 The torch side imports the reference's own `LBFGSNew` from
 /root/reference/src (imported, NOT copied) and re-drives the algorithms
@@ -69,11 +72,20 @@ NLOOP_SIMPLE = int(os.environ.get("PARITY_NLOOP", "8"))
 HARDNESS = dict(noise=110.0, overlap=0.35, label_noise=0.25)
 
 SIMPLE = dict(batch=64, n_train=960)   # 320/client -> 5 lockstep batches
-RESNET = dict(batch=32, n_train=192)   # 64/client -> 2 lockstep batches
+# 128/client -> 4 lockstep batches of 32. Small on purpose: the torch
+# side pays ~36 s per lockstep minibatch on this 1-core host
+# (benchmarks/reference_throughput.json), so the full-10-block resnet
+# schedule at RESNET_NLOOP outer loops is hours, not days — the dataset
+# is shrunk and the loop count raised until both sides learn well above
+# chance (round-2 VERDICT item 1: "shrink the dataset / raise epochs
+# rather than truncating blocks")
+RESNET = dict(batch=32, n_train=int(os.environ.get("PARITY_RESNET_NTRAIN",
+                                                   "384")))
+NLOOP_RESNET = int(os.environ.get("PARITY_RESNET_NLOOP", "2"))
 
 REFERENCE_SRC = os.environ.get("REFERENCE_SRC", "/root/reference/src")
 
-ADMM_RHO0 = 1e-3
+ADMM_RHO0 = float(os.environ.get("PARITY_RHO0", "1e-3"))
 BB = dict(period=2, corr_min=0.2, eps=1e-3, rho_max=0.1)
 
 
@@ -402,11 +414,10 @@ def _log_ratio_band(fw, ref):
 
 
 def compare(fw, ref, strategy, acc_band=0.05):
-    """`acc_band` is the final-accuracy tolerance. The simple configs run
-    the full schedule to the ~0.78 plateau, where 0.05 is meaningful; the
-    REDUCED resnet configs train 6 rounds from near-chance, where the
-    accuracy signal is shuffle noise (both sides sit at 0.10-0.25) — they
-    get a wider band and their real oracle is the residual trajectories.
+    """`acc_band` is the final-accuracy tolerance: all four configs run
+    their FULL schedule until both sides sit well above chance, where a
+    0.05 band on the plateau is a meaningful oracle (a wrong consensus
+    step costs more than that; shuffle noise costs less).
     """
     fa, ra = _mean_curve(fw["acc"]), _mean_curve(ref["acc"])
     m = min(len(fa), len(ra))
@@ -445,11 +456,11 @@ CONFIGS = {
                         nloop=NLOOP_SIMPLE, nadmm=5, group_slice=None,
                         acc_band=0.05, **SIMPLE),
     "fedavg_resnet": dict(kind="resnet18", strategy="fedavg", bb=False,
-                          nloop=1, nadmm=3, group_slice=2, acc_band=0.10,
-                          **RESNET),
+                          nloop=NLOOP_RESNET, nadmm=3, group_slice=None,
+                          acc_band=0.05, **RESNET),
     "admm_resnet": dict(kind="resnet18", strategy="admm", bb=False,
-                        nloop=1, nadmm=3, group_slice=2, acc_band=0.10,
-                        **RESNET),
+                        nloop=NLOOP_RESNET, nadmm=3, group_slice=None,
+                        acc_band=0.05, **RESNET),
 }
 
 PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -1,0 +1,79 @@
+"""Tile sweep for the full-f32 flash kernels at short sequence lengths.
+
+Round-2 result: flash in 'highest' (full f32 matmul passes) LOSES to
+dense XLA attention at S=1024 (0.79x) while winning at S>=2048. This
+probe times the f32 fwd+bwd step across (block_q, block_k) tile pairs at
+S=1024/2048 against dense, to either find a winning tile shape for the
+short-S f32 regime or measure that none exists (in which case dense IS
+the right implementation there and the dispatch docs say so).
+
+Run: python benchmarks/flash_f32_tiles.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from federated_pytorch_test_tpu.ops.flash_attention import flash_attention
+from federated_pytorch_test_tpu.parallel import dense_attention
+from tpu_timing import make_fwd_bwd_step, timed
+
+B, H, D = 2, 8, 64
+TILES = [(512, 512), (256, 512), (512, 256), (256, 256), (128, 256),
+         (256, 128), (128, 128), (1024, 512), (512, 1024)]
+
+
+def main():
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    rng = np.random.RandomState(0)
+    reps = 3
+    out = {"rows": []}
+    for s in (1024, 2048):
+        inner = max(4, (8192 * 8192) // (s * s) * 4)
+        qs, ks, vs = (
+            [jnp.asarray(rng.randn(B, s, H, D), jnp.float32)
+             for _ in range(reps + 1)]
+            for _ in range(3)
+        )
+        float(sum(x[0, 0, 0, 0] for x in qs + ks + vs))
+        t_dense = timed(
+            make_fwd_bwd_step(dense_attention, "highest", inner),
+            qs, ks, vs, reps, inner,
+        )
+        row = {"seq_len": s, "dense_step_s": round(t_dense, 5), "tiles": {}}
+        for bq, bk in TILES:
+            if bq > s or bk > s:
+                continue
+            attn = lambda q, k, v, causal: flash_attention(
+                q, k, v, causal=causal, precision="highest",
+                block_q=bq, block_k=bk,
+            )
+            try:
+                t = timed(
+                    make_fwd_bwd_step(attn, "highest", inner),
+                    qs, ks, vs, reps, inner,
+                )
+                row["tiles"][f"{bq}x{bk}"] = {
+                    "step_s": round(t, 5),
+                    "speedup_vs_dense": round(t_dense / t, 3),
+                }
+            except Exception as e:
+                row["tiles"][f"{bq}x{bk}"] = {"error": str(e)[:120]}
+            print(json.dumps({"s": s, "tile": f"{bq}x{bk}",
+                              **row["tiles"][f"{bq}x{bk}"]}), flush=True)
+        out["rows"].append(row)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "flash_f32_tiles.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Framework-side learning-curve explorer for the resnet parity configs.
+
+The torch reference pays ~36 s per lockstep minibatch on this host, so
+the (n_train, nloop, hardness) point for the FULL 10-block resnet parity
+runs must be chosen before spending hours on the torch side. This runs
+ONLY the framework half of a convergence_parity config (fast on the
+chip) and prints the per-round accuracy curve + an estimate of what the
+matching torch run would cost.
+
+Usage:
+  python benchmarks/parity_explore.py fedavg_resnet
+  PARITY_RESNET_NLOOP=4 python benchmarks/parity_explore.py admm_resnet
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import convergence_parity as cp
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "fedavg_resnet"
+    c = cp.CONFIGS[name]
+    src = cp.synthetic(c["n_train"])
+    import time
+
+    t0 = time.time()
+    fw = cp.run_framework(c["kind"], src, c["batch"], c["nloop"], c["nadmm"],
+                          c["strategy"], c["bb"], c["group_slice"])
+    dt = time.time() - t0
+    curve = cp._mean_curve(fw["acc"])
+    n_groups = 10 if c["kind"] == "resnet18" else 5
+    steps = (c["n_train"] // cp.K) // c["batch"]
+    torch_minibatches = c["nloop"] * n_groups * c["nadmm"] * steps
+    print(json.dumps({
+        "config": name,
+        "n_train": c["n_train"],
+        "nloop": c["nloop"],
+        "framework_seconds": round(dt, 1),
+        "acc_first": curve[0],
+        "acc_last": curve[-1],
+        "acc_curve": [round(a, 3) for a in curve],
+        "dual_first_last": [fw["dual"][0], fw["dual"][-1]]
+        if fw["dual"] else None,
+        "est_torch_hours": round(torch_minibatches * 36.3 / 3600, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Bisect the resident-epoch scan length that kills the tunneled TPU worker.
+
+Round-2 observation (BASELINE.md): the fedavg_resnet preset's resident
+epoch — ONE jitted call scanning 520 lockstep ResNet18 minibatches —
+crashes this environment's tunneled TPU worker, while 8-step streamed
+chunks run fine. This probe pins the boundary: it builds the exact
+fedavg_resnet group-0 epoch program and runs it with ascending scan
+lengths S (idx sliced to [S, K, B]), fetching the losses to the host
+after each call (the only true completion barrier over the tunnel).
+
+The last S that completes and the first S that crashes bound the safe
+chunk size for the trainer's resident auto-chunking (`max_scan_steps`).
+
+Usage: python benchmarks/scan_bisect_tpu.py [S ...]   (default sweep below)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+
+def main():
+    steps = [int(s) for s in sys.argv[1:]] or [8, 65, 130, 260, 390, 520]
+    smax = max(steps)
+    # big enough shard for smax lockstep batches per client
+    cfg = get_preset(
+        "fedavg_resnet",
+        synthetic_n_train=3 * smax * 32,
+        synthetic_n_test=96,
+        check_results=False,
+        nloop=1,
+        fault_mode="off",
+        max_scan_steps=None,  # probe the raw un-chunked scan
+    )
+    tr = Trainer(cfg, verbose=False)
+    gid = tr.group_order[0]
+    epoch_fn, _, init_fn = tr._fns(gid)
+    lstate, y, z, rho, _ = init_fn(tr.flat)
+    idx_full = tr._epoch_indices(0, gid, 0, 0)
+    print(f"probe ready: shard={tr.fed.shard_size} full_S={idx_full.shape[0]}",
+          flush=True)
+
+    # the epoch fn donates flat/lstate/stats; thread the outputs through
+    flat, stats = tr.flat, tr.stats
+    for s in steps:
+        t0 = time.perf_counter()
+        try:
+            flat, lstate, stats, losses = epoch_fn(
+                flat, lstate, stats, tr.shard_imgs, tr.shard_labels,
+                idx_full[:s], tr.mean, tr.std, y, z, rho,
+            )
+            host = np.asarray(losses)  # completion barrier
+            dt = time.perf_counter() - t0
+            print(f"S={s:4d}  OK    {dt:7.1f}s  mean_loss={host.mean():.4f}",
+                  flush=True)
+        except Exception as e:
+            dt = time.perf_counter() - t0
+            print(f"S={s:4d}  CRASH {dt:7.1f}s  {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()

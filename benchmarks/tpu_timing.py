@@ -1,0 +1,60 @@
+"""Shared tunnel-safe timing harness for the attention benchmarks.
+
+This runtime's TPU sits behind a remote PJRT tunnel with three
+measurement traps (see BASELINE.md): `block_until_ready` returns at
+dispatch-ack rather than completion (only a device->host scalar fetch is
+a true barrier), per-call dispatch latency is ~0.1 s flat in problem
+size (so real kernel time must be amortized by looping `inner` steps
+inside one jitted call), and the chip is shared (so best-of-N minima,
+never means). Both long_context_tpu.py and flash_f32_tiles.py measure
+through these two helpers so the protocol lives in exactly one place.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def make_fwd_bwd_step(attn, prec, inner):
+    """Jitted `inner`-step fwd+bwd loop over `attn(q, k, v, causal=True)`.
+
+    `prec` is applied as the default matmul precision around the
+    attention call (covers the dense path; the flash kernels take their
+    precision as a kwarg, already bound into `attn` by the caller). Each
+    iteration perturbs q so no dispatch repeats the previous one's
+    inputs, and every gradient is fully reduced into the scalar result
+    so none is dead code.
+    """
+
+    def step(q, k, v):
+        def loss(q, k, v):
+            with jax.default_matmul_precision(prec):
+                out = attn(q, k, v, causal=True)
+            return jnp.sum(out**2)
+
+        def body(i, acc):
+            qi = q * (1.0 + i.astype(jnp.float32) * 1e-6)
+            l, gs = jax.value_and_grad(loss, argnums=(0, 1, 2))(qi, k, v)
+            return acc + l + sum(jnp.sum(g) for g in gs)
+
+        return jax.lax.fori_loop(0, inner, body, jnp.float32(0))
+
+    return jax.jit(step)
+
+
+def timed(step, qs, ks, vs, reps, inner):
+    """Best-of-`reps` PER-STEP time over distinct resident inputs.
+
+    Input set 0 is burned on compile+warmup; sets 1..reps are each timed
+    individually (scalar fetch = completion barrier) and the MINIMUM is
+    reported: on the shared chip a single contended rep would otherwise
+    poison a mean.
+    """
+    float(step(qs[0], ks[0], vs[0]))
+    best = float("inf")
+    for i in range(1, reps + 1):
+        t0 = time.perf_counter()
+        float(step(qs[i], ks[i], vs[i]))  # forces the call; fetches 4 bytes
+        best = min(best, time.perf_counter() - t0)
+    return best / inner

@@ -108,6 +108,16 @@ class ExperimentConfig:
     # lockstep minibatches per streamed chunk (one jitted scan per chunk;
     # larger chunks amortize dispatch, smaller ones bound staging memory)
     stream_chunk_steps: int = 8
+    # cap on lockstep minibatches per RESIDENT jitted epoch call: epochs
+    # longer than this run as ceil(S/cap) sequential calls over index
+    # slices (bit-identical trajectory — the scan is sequential either
+    # way; the remainder slice costs one extra compile). Exists because a
+    # single program scanning many hundred ResNet steps can exceed what a
+    # TPU runtime will execute in one dispatch (the round-2 tunneled-v5e
+    # worker died on the 520-step fedavg_resnet epoch; see
+    # benchmarks/scan_bisect_tpu.py for the probe that pins the boundary).
+    # None = never chunk.
+    max_scan_steps: int | None = 256
 
     # write a jax.profiler trace of each epoch here (TPU/host timelines)
     profile_dir: str | None = None
@@ -171,6 +181,10 @@ class ExperimentConfig:
             )
         if self.max_groups is not None and self.max_groups < 1:
             raise ValueError(f"max_groups must be >= 1, got {self.max_groups}")
+        if self.max_scan_steps is not None and self.max_scan_steps < 1:
+            raise ValueError(
+                f"max_scan_steps must be >= 1, got {self.max_scan_steps}"
+            )
 
     def lbfgs_config(self) -> LBFGSConfig:
         return LBFGSConfig(

@@ -175,14 +175,6 @@ class Trainer:
                 raise NotImplementedError(
                     "eval_every_batch needs the resident data path"
                 )
-            if cfg.load_model or cfg.save_model:
-                raise NotImplementedError(
-                    "checkpoint/resume with the streaming path would not "
-                    "replay the exact trajectory: the batchers' stream "
-                    "positions are not part of the checkpoint (the "
-                    "resident path reseeds per (nloop, gid, nadmm, epoch) "
-                    "instead — see _epoch_indices)"
-                )
             from federated_pytorch_test_tpu.data.native import PrefetchBatcher
 
             self.shard_imgs = None
@@ -450,6 +442,38 @@ class Trainer:
             [self._fetch(l) for l in losses], axis=0
         )
 
+    def _run_resident_epoch(self, epoch_fn, lstate, y, z, rho, idx):
+        """One resident epoch, auto-chunked to `cfg.max_scan_steps`.
+
+        A single jitted program scanning many hundred training steps can
+        exceed what a TPU runtime will execute in one dispatch (the
+        round-2 tunneled worker died on the 520-step ResNet epoch —
+        benchmarks/scan_bisect_tpu.py pins the boundary), so epochs
+        longer than the cap run as sequential calls over `idx` slices.
+        The trajectory is bit-identical: the scan is sequential either
+        way, and `flat/lstate/stats` carry across calls exactly as they
+        carry across scan iterations. Returns `(lstate, losses [S, K])`.
+        """
+        cap = self.cfg.max_scan_steps
+        s_total = idx.shape[0]
+        if cap is None or s_total <= cap:
+            self.flat, lstate, self.stats, losses = epoch_fn(
+                self.flat, lstate, self.stats, self.shard_imgs,
+                self.shard_labels, idx, self.mean, self.std, y, z, rho,
+            )
+            return lstate, self._fetch(losses)
+        losses = []
+        for lo in range(0, s_total, cap):
+            self.flat, lstate, self.stats, l = epoch_fn(
+                self.flat, lstate, self.stats, self.shard_imgs,
+                self.shard_labels, idx[lo : lo + cap], self.mean,
+                self.std, y, z, rho,
+            )  # asynchronous dispatch: slices queue back-to-back
+            losses.append(l)
+        return lstate, np.concatenate(
+            [self._fetch(l) for l in losses], axis=0
+        )
+
     def run_round(self, nloop: int, gid: int) -> None:
         """One partition group's full round: init, Nadmm x (epochs + consensus)."""
         cfg = self.cfg
@@ -510,20 +534,9 @@ class Trainer:
                             )
                         losses = np.stack(rows)  # [S, K]
                     else:
-                        self.flat, lstate, self.stats, losses = epoch_fn(
-                            self.flat,
-                            lstate,
-                            self.stats,
-                            self.shard_imgs,
-                            self.shard_labels,
-                            idx,
-                            self.mean,
-                            self.std,
-                            y,
-                            z,
-                            rho,
-                        )
-                        losses = self._fetch(losses)  # [S, K]
+                        lstate, losses = self._run_resident_epoch(
+                            epoch_fn, lstate, y, z, rho, idx
+                        )  # [S, K]
                 self.recorder.step_time(
                     "epoch",
                     time.perf_counter() - t0,
@@ -635,6 +648,18 @@ class Trainer:
                 str(g): self._fetch(r) for g, r in self._rho_store.items()
             },
         }
+        if self._stream:
+            # the streams are pure functions of (seed, batch, drop_last,
+            # drawn-count) — the count IS the data-pipeline state
+            from federated_pytorch_test_tpu.data import native as _native
+
+            state["stream_positions"] = np.asarray(
+                [b.drawn for b in self._batchers], np.int64
+            )
+            # 1 = native batcher, 0 = numpy fallback (different streams)
+            state["stream_impl_native"] = np.int64(
+                _native.get_lib() is not None
+            )
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
     def _restore(self) -> None:
@@ -647,6 +672,38 @@ class Trainer:
         self._completed_nloops = int(state["completed_nloops"])
         for g, r in state.get("rho_store", {}).items():
             self._rho_store[int(g)] = self._put(r, csh)
+        if not self._stream and "stream_positions" in state:
+            # the mirror-image mismatch: a streaming checkpoint resumed
+            # resident would silently continue under the reseeded
+            # _epoch_indices stream instead of the saved batcher positions
+            raise ValueError(
+                "checkpoint was written by a STREAMING run; resuming it "
+                "on the resident data path would silently change the "
+                "minibatch order (set hbm_data_budget_mb to match the "
+                "original run)"
+            )
+        if self._stream:
+            if "stream_positions" not in state:
+                raise ValueError(
+                    "checkpoint was written by a resident-data run; it "
+                    "cannot seed the streaming batchers' positions "
+                    "(rerun without hbm_data_budget_mb, or restart)"
+                )
+            from federated_pytorch_test_tpu.data import native as _native
+
+            impl = int(_native.get_lib() is not None)
+            saved = int(state["stream_impl_native"])
+            if saved != impl:
+                names = {1: "native", 0: "numpy-fallback"}
+                raise ValueError(
+                    f"checkpoint stream positions were written under the "
+                    f"{names[saved]} batcher but this process runs the "
+                    f"{names[impl]} one — their permutation streams "
+                    "differ, so resuming would silently change the data "
+                    "order (set/unset FEDTPU_NO_NATIVE to match)"
+                )
+            for b, pos in zip(self._batchers, state["stream_positions"]):
+                b.skip(int(pos))
 
 
 def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> MetricsRecorder:

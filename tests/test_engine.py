@@ -502,11 +502,11 @@ def test_streaming_data_path_trains():
         b.close()
 
 
-def test_streaming_rejects_incompatible_modes():
-    # the streaming path cannot honor per-batch eval (resident-only) or
-    # exact-replay checkpointing (batcher stream positions are not
-    # checkpointed) — both must fail LOUDLY at construction, not diverge
-    # silently mid-run
+def test_streaming_rejects_incompatible_modes(tmp_path):
+    # the streaming path cannot honor per-batch eval (resident-only) —
+    # fail LOUDLY at construction, not diverge silently mid-run. A
+    # checkpoint written by a RESIDENT run carries no stream positions,
+    # so resuming it under streaming must also fail loudly.
     base = dict(model="net", hbm_data_budget_mb=0)
     with pytest.raises(NotImplementedError, match="eval_every_batch"):
         Trainer(
@@ -514,12 +514,100 @@ def test_streaming_rejects_incompatible_modes():
             verbose=False,
             source=SRC,
         )
-    with pytest.raises(NotImplementedError, match="checkpoint"):
+    cfg = tiny("fedavg", model="net", nloop=1, nadmm=1, save_model=True,
+               checkpoint_dir=str(tmp_path))
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    tr.group_order = tr.group_order[:1]
+    tr.run()
+    with pytest.raises(ValueError, match="resident"):
         Trainer(
-            tiny("fedavg", save_model=True, **base),
+            tiny("fedavg", nloop=2, load_model=True,
+                 checkpoint_dir=str(tmp_path), **base),
             verbose=False,
             source=SRC,
         )
+    # ... and the mirror image: a STREAMING checkpoint resumed resident
+    # would silently reseed the minibatch stream — must also fail loudly
+    cfg_s = tiny("fedavg", nloop=1, nadmm=1, save_model=True,
+                 checkpoint_dir=str(tmp_path / "s"), **base)
+    tr_s = Trainer(cfg_s, verbose=False, source=SRC)
+    tr_s.group_order = tr_s.group_order[:1]
+    tr_s.run()
+    with pytest.raises(ValueError, match="STREAMING"):
+        Trainer(
+            tiny("fedavg", model="net", nloop=2, load_model=True,
+                 checkpoint_dir=str(tmp_path / "s")),
+            verbose=False,
+            source=SRC,
+        )
+
+
+def test_stream_resume_replays_exact_trajectory(tmp_path):
+    # streaming checkpoint/resume (round-2 VERDICT item 4): the batchers'
+    # streams are pure functions of (seed, batch, drawn-count), the drawn
+    # counts are checkpointed, and restore fast-forwards fresh batchers —
+    # so a resumed streaming run must replay the uninterrupted trajectory
+    # bit for bit, exactly like the resident path.
+    src = synthetic_cifar(n_train=360, n_test=60)
+    common = dict(
+        model="net", nadmm=2, save_model=True, check_results=True,
+        eval_batch=30, hbm_data_budget_mb=0, stream_chunk_steps=2,
+    )
+    cfg_a = tiny("fedavg", nloop=2, checkpoint_dir=str(tmp_path / "a"),
+                 **common)
+    tr_a = Trainer(cfg_a, verbose=False, source=src)
+    tr_a.group_order = tr_a.group_order[:1]
+    rec_a = tr_a.run()
+
+    cfg_b = tiny("fedavg", nloop=1, checkpoint_dir=str(tmp_path / "b"),
+                 **common)
+    tr_b = Trainer(cfg_b, verbose=False, source=src)
+    tr_b.group_order = tr_b.group_order[:1]
+    tr_b.run()
+    drawn_at_save = [b.drawn for b in tr_b._batchers]
+    assert all(d > 0 for d in drawn_at_save)
+
+    cfg_b2 = cfg_b.replace(nloop=2, load_model=True)
+    tr_b2 = Trainer(cfg_b2, verbose=False, source=src)
+    tr_b2.group_order = tr_b2.group_order[:1]
+    assert tr_b2._completed_nloops == 1
+    assert [b.drawn for b in tr_b2._batchers] == drawn_at_save  # fast-forwarded
+    rec_b2 = tr_b2.run()
+
+    np.testing.assert_array_equal(
+        np.asarray(tr_b2.flat), np.asarray(tr_a.flat)
+    )
+    for name in ("train_loss", "dual_residual", "test_accuracy"):
+        a_vals = [r["value"] for r in rec_a.series[name] if r["nloop"] == 1]
+        b_vals = [r["value"] for r in rec_b2.series[name]]
+        assert a_vals == b_vals, name
+    for tr in (tr_a, tr_b, tr_b2):
+        for b in tr._batchers:
+            b.close()
+
+
+def test_resident_auto_chunking_is_bit_identical():
+    # max_scan_steps caps the minibatches per jitted resident call (the
+    # guard for TPU runtimes that die on very long scans — round-2's
+    # 520-step crash). Chunked (cap 2 over 3 steps: a 2-slice + a tail
+    # slice) must produce the EXACT trajectory of the single-call epoch.
+    src = synthetic_cifar(n_train=360, n_test=60)  # 3 minibatches/epoch
+    base = dict(model="net", nadmm=2, check_results=False)
+    tr_one = Trainer(tiny("fedavg", max_scan_steps=None, **base),
+                     verbose=False, source=src)
+    tr_one.group_order = tr_one.group_order[:1]
+    rec_one = tr_one.run()
+    tr_chk = Trainer(tiny("fedavg", max_scan_steps=2, **base),
+                     verbose=False, source=src)
+    tr_chk.group_order = tr_chk.group_order[:1]
+    rec_chk = tr_chk.run()
+
+    np.testing.assert_array_equal(
+        np.asarray(tr_one.flat), np.asarray(tr_chk.flat)
+    )
+    l1 = [r["value"] for r in rec_one.series["train_loss"]]
+    l2 = [r["value"] for r in rec_chk.series["train_loss"]]
+    assert l1 == l2  # per-minibatch losses identical, chunked or not
 
 
 def test_max_groups_limits_partition_order():
