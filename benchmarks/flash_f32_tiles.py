@@ -68,6 +68,26 @@ def main():
             print(json.dumps({"s": s, "tile": f"{bq}x{bk}",
                               **row["tiles"][f"{bq}x{bk}"]}), flush=True)
         out["rows"].append(row)
+    best1k = max(
+        (t.get("speedup_vs_dense", 0.0) for t in out["rows"][0]["tiles"].values()),
+        default=0.0,
+    )
+    if best1k < 1.0:
+        out["conclusion"] = (
+            f"no tile shape beats dense at S=1024 full-f32 (best {best1k}x "
+            "of 9 swept): at short S the f32 multi-pass matmuls cannot "
+            "amortize the per-tile overhead against XLA's fused dense "
+            "path, so short-S f32 attention BELONGS to dense — encoded as "
+            "the attn_impl='auto' dispatch crossover "
+            "(models/transformer.py: flash from S>=2048)"
+        )
+    else:
+        out["conclusion"] = (
+            f"a swept tile shape now BEATS dense at S=1024 full-f32 "
+            f"(best {best1k}x): revisit the attn_impl='auto' crossover in "
+            "models/transformer.py, which currently assumes dense wins "
+            "below S=2048"
+        )
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "flash_f32_tiles.json")
     with open(path, "w") as f:

@@ -208,6 +208,15 @@ class PrefetchBatcher:
         # are the checkpoint/resume contract for streaming training runs
         self.drawn = 0
 
+    @property
+    def is_native(self) -> bool:
+        """True iff THIS batcher draws from the native producer.
+
+        Not the same as "the library loaded": a failed `batcher_create`
+        silently falls back to the numpy stream, whose permutations
+        differ — checkpoints must record what actually ran."""
+        return self._handle is not None
+
     def __iter__(self):
         return self
 

@@ -651,14 +651,14 @@ class Trainer:
         if self._stream:
             # the streams are pure functions of (seed, batch, drop_last,
             # drawn-count) — the count IS the data-pipeline state
-            from federated_pytorch_test_tpu.data import native as _native
-
             state["stream_positions"] = np.asarray(
                 [b.drawn for b in self._batchers], np.int64
             )
-            # 1 = native batcher, 0 = numpy fallback (different streams)
+            # 1 = native batchers, 0 = numpy fallback (different
+            # streams). Per-batcher, not get_lib(): a failed
+            # batcher_create falls back to numpy even with the lib loaded
             state["stream_impl_native"] = np.int64(
-                _native.get_lib() is not None
+                all(b.is_native for b in self._batchers)
             )
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
@@ -689,9 +689,7 @@ class Trainer:
                     "cannot seed the streaming batchers' positions "
                     "(rerun without hbm_data_budget_mb, or restart)"
                 )
-            from federated_pytorch_test_tpu.data import native as _native
-
-            impl = int(_native.get_lib() is not None)
+            impl = int(all(b.is_native for b in self._batchers))
             saved = int(state["stream_impl_native"])
             if saved != impl:
                 names = {1: "native", 0: "numpy-fallback"}

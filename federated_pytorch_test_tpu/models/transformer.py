@@ -56,12 +56,27 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        if self.attn_impl not in ("dense", "ring", "flash", "ring_flash"):
+        if self.attn_impl not in (
+            "dense", "ring", "flash", "ring_flash", "auto"
+        ):
             raise ValueError(
-                f"attn_impl must be 'dense', 'ring', 'flash' or "
-                f"'ring_flash', got {self.attn_impl!r}"
+                f"attn_impl must be 'dense', 'ring', 'flash', "
+                f"'ring_flash' or 'auto', got {self.attn_impl!r}"
             )
         b, s, _ = x.shape
+        impl = self.attn_impl
+        if impl == "auto":
+            # measured single-chip crossover (benchmarks/
+            # long_context_tpu.json, flash_f32_tiles.json): the flash
+            # kernels beat dense XLA attention solidly from S>=2048
+            # (2.8x 'default', 1.05-1.35x full-f32). At S=1024 the two
+            # measurements straddle parity ('default': 1.17x round 2,
+            # 0.94x round 3 — within shared-chip noise) and full-f32
+            # loses with every tile shape (9 swept), so below 2048
+            # dense's fused [S,S] path is the safe pick and its score
+            # memory is affordable. S is static under jit, so this
+            # resolves at trace time.
+            impl = "flash" if s >= 2048 else "dense"
         h, hd = self.num_heads, self.dim // self.num_heads
         qkv = nn.Dense(
             3 * self.dim, name="qkv", kernel_init=kernel_init,
@@ -73,16 +88,16 @@ class MultiHeadAttention(nn.Module):
         q, k, v = jnp.split(
             qkv.reshape(b, s, 3 * h, hd).astype(jnp.float32), 3, axis=2
         )
-        if self.attn_impl in ("ring", "ring_flash"):
+        if impl in ("ring", "ring_flash"):
             # 'ring_flash' = same ring schedule with the Pallas flash
             # kernel as each step's block compute (two-level streaming:
             # ICI across devices, VMEM tiles within)
             out = ring_attention(
                 q, k, v, axis_name=self.seq_axis, causal=self.causal,
-                use_flash=self.attn_impl == "ring_flash",
+                use_flash=impl == "ring_flash",
                 precision=self.attn_precision,
             )
-        elif self.attn_impl == "flash":
+        elif impl == "flash":
             # Pallas blockwise kernels (ops/flash_attention.py): no [S, S]
             # scores in HBM — the long-context single-device path
             from federated_pytorch_test_tpu.ops.flash_attention import (

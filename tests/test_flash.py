@@ -144,6 +144,37 @@ def test_flash_default_precision_mode():
         flash_attention(q, k, v, precision="fast")
 
 
+def test_auto_attn_dispatch_matches_measured_crossover():
+    # attn_impl='auto' picks dense below the measured flash crossover
+    # (S>=1024 'default' / S>=2048 f32 — benchmarks/flash_f32_tiles.json)
+    # and flash above it. Bit-equality against the explicit impls proves
+    # which core ran (same params, same ops).
+    from federated_pytorch_test_tpu.models.transformer import (
+        MultiHeadAttention,
+    )
+
+    rng = np.random.default_rng(12)
+
+    def outs(s, prec):
+        x = jnp.asarray(rng.normal(size=(1, s, 32)), jnp.float32)
+        mods = {
+            name: MultiHeadAttention(
+                32, 2, attn_impl=name, causal=True, attn_precision=prec
+            )
+            for name in ("auto", "dense", "flash")
+        }
+        params = mods["dense"].init(jax.random.PRNGKey(0), x)
+        return {n: np.asarray(m.apply(params, x)) for n, m in mods.items()}
+
+    o = outs(256, None)  # f32, short: auto must BE dense
+    np.testing.assert_array_equal(o["auto"], o["dense"])
+    o = outs(2048, "default")  # past the crossover: flash
+    np.testing.assert_array_equal(o["auto"], o["flash"])
+    assert np.abs(o["flash"] - o["dense"]).max() > 0.0  # distinct cores
+    o = outs(1024, "default")  # S=1024 straddles parity: dense (safe pick)
+    np.testing.assert_array_equal(o["auto"], o["dense"])
+
+
 def test_flash_rejects_ragged_seq():
     q, k, v = _qkv(s=100)
     with pytest.raises(ValueError, match="divisible"):
