@@ -241,7 +241,8 @@ def main() -> None:
     # in the BENCH_DEVICE=cpu escape hatch — the batch-512/2048 rows and
     # the 16k matmul probe are hours on a host core.
     run_sweep = (
-        os.environ.get("BENCH_SWEEP", "1") != "0" and bench_device != "cpu"
+        os.environ.get("BENCH_SWEEP", "1") != "0"
+        and jax.devices()[0].platform != "cpu"  # incl. TPU-less fallback
     )
     if run_sweep:
         sweep_specs = [

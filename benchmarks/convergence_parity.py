@@ -90,6 +90,28 @@ BB = dict(period=2, corr_min=0.2, eps=1e-3, rho_max=0.1)
 
 
 def synthetic(n_train):
+    """The suite's dataset: discriminating synthetic by default, or the
+    REAL archive (`PARITY_DATA=real`, root from $CIFAR_DATA_DIR) when one
+    is present — same deterministic subsample on both sides, retiring
+    the "all parity evidence is synthetic" cap the moment an archive
+    exists (scripts/parity_suite.sh is the rehearsed one-command path).
+    """
+    if os.environ.get("PARITY_DATA") == "real":
+        import dataclasses
+
+        from federated_pytorch_test_tpu.data import load_cifar
+
+        src = load_cifar("cifar10", synthetic_ok=False)
+        rng = np.random.default_rng(SEED)
+        tr = rng.permutation(len(src.train_images))[:n_train]
+        te = rng.permutation(len(src.test_images))[:N_TEST]
+        return dataclasses.replace(
+            src,
+            train_images=src.train_images[tr],
+            train_labels=src.train_labels[tr],
+            test_images=src.test_images[te],
+            test_labels=src.test_labels[te],
+        )
     from federated_pytorch_test_tpu.data import synthetic_cifar
 
     return synthetic_cifar(

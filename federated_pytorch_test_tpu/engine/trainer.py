@@ -654,11 +654,12 @@ class Trainer:
             state["stream_positions"] = np.asarray(
                 [b.drawn for b in self._batchers], np.int64
             )
-            # 1 = native batchers, 0 = numpy fallback (different
-            # streams). Per-batcher, not get_lib(): a failed
-            # batcher_create falls back to numpy even with the lib loaded
-            state["stream_impl_native"] = np.int64(
-                all(b.is_native for b in self._batchers)
+            # 1 = native batcher, 0 = numpy fallback (different streams),
+            # saved PER BATCHER: a failed batcher_create falls back to
+            # numpy even with the lib loaded, and a mixed run must not
+            # collapse into either label
+            state["stream_impl_native"] = np.asarray(
+                [b.is_native for b in self._batchers], np.int64
             )
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
@@ -689,14 +690,16 @@ class Trainer:
                     "cannot seed the streaming batchers' positions "
                     "(rerun without hbm_data_budget_mb, or restart)"
                 )
-            impl = int(all(b.is_native for b in self._batchers))
-            saved = int(state["stream_impl_native"])
-            if saved != impl:
-                names = {1: "native", 0: "numpy-fallback"}
+            impl = np.asarray(
+                [b.is_native for b in self._batchers], np.int64
+            )
+            saved = np.asarray(state["stream_impl_native"]).reshape(-1)
+            if not np.array_equal(saved, impl):
                 raise ValueError(
-                    f"checkpoint stream positions were written under the "
-                    f"{names[saved]} batcher but this process runs the "
-                    f"{names[impl]} one — their permutation streams "
+                    f"checkpoint stream positions were written under "
+                    f"per-client batcher impls {saved.tolist()} (1=native,"
+                    f" 0=numpy fallback) but this process built "
+                    f"{impl.tolist()} — the two permutation streams "
                     "differ, so resuming would silently change the data "
                     "order (set/unset FEDTPU_NO_NATIVE to match)"
                 )

@@ -76,7 +76,9 @@ class MultiHeadAttention(nn.Module):
             # dense's fused [S,S] path is the safe pick and its score
             # memory is affordable. S is static under jit, so this
             # resolves at trace time.
-            impl = "flash" if s >= 2048 else "dense"
+            # (the flash kernels also need S % 128 == 0 — ragged lengths
+            # always take dense, whatever their size)
+            impl = "flash" if s >= 2048 and s % 128 == 0 else "dense"
         h, hd = self.num_heads, self.dim // self.num_heads
         qkv = nn.Dense(
             3 * self.dim, name="qkv", kernel_init=kernel_init,
