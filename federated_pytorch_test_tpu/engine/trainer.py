@@ -110,6 +110,21 @@ class Trainer:
         self.has_stats = "batch_stats" in variables
         stats = variables.get("batch_stats", {})
 
+        # transformer-family checkpoints carry the fused-qkv column-order
+        # version: the layout changed between rounds (head-major v2,
+        # models/transformer.py QKV_LAYOUT_VERSION) and a stale checkpoint
+        # would load shape-compatibly but compute scrambled attention
+        self._qkv_layout = None
+        if any(
+            "qkv" in jax.tree_util.keystr(path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(params_t)[0]
+        ):
+            from federated_pytorch_test_tpu.models.transformer import (
+                QKV_LAYOUT_VERSION,
+            )
+
+            self._qkv_layout = QKV_LAYOUT_VERSION
+
         # model partition (layer/block groups + metadata)
         self.model_partition = self.model.partition(params_t)
         # training partition: the trivial whole-vector group for independent
@@ -648,6 +663,8 @@ class Trainer:
                 str(g): self._fetch(r) for g, r in self._rho_store.items()
             },
         }
+        if self._qkv_layout is not None:
+            state["qkv_layout"] = np.int64(self._qkv_layout)
         if self._stream:
             # the streams are pure functions of (seed, batch, drop_last,
             # drawn-count) — the count IS the data-pipeline state
@@ -671,6 +688,17 @@ class Trainer:
             lambda x: self._put(x, csh), state["batch_stats"]
         )
         self._completed_nloops = int(state["completed_nloops"])
+        if self._qkv_layout is not None:
+            saved = int(state.get("qkv_layout", 1))  # pre-stamp ckpts are v1
+            if saved != self._qkv_layout:
+                raise ValueError(
+                    f"checkpoint's fused-qkv column order is v{saved} but "
+                    f"this build uses v{self._qkv_layout} "
+                    "(models/transformer.py QKV_LAYOUT_VERSION): the same "
+                    "kernel shapes would be read as different heads' q/k/v "
+                    "and attention would be silently scrambled — re-train "
+                    "or convert the checkpoint"
+                )
         for g, r in state.get("rho_store", {}).items():
             self._rho_store[int(g)] = self._put(r, csh)
         if not self._stream and "stream_positions" in state:

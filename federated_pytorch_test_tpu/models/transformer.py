@@ -40,6 +40,16 @@ from federated_pytorch_test_tpu.parallel.ring import (
 )
 
 
+# Version stamp for the fused qkv projection's column ORDER. v2 = the
+# head-major layout ([h0(q,k,v), h1(q,k,v), ...]) that makes a contiguous
+# `model`-axis split head-local (parallel/tensor.py); v1 (rounds 1-2) was
+# [q-heads, k-heads, v-heads]. The two interpret the same kernel shape
+# differently, so a v1 checkpoint loaded under v2 would compute scrambled
+# attention WITHOUT any shape error — the engine stamps this version into
+# transformer-family checkpoints and refuses a mismatch (engine/trainer.py).
+QKV_LAYOUT_VERSION = 2
+
+
 class MultiHeadAttention(nn.Module):
     """QKV projection + pluggable attention core + output projection."""
 
@@ -86,10 +96,14 @@ class MultiHeadAttention(nn.Module):
         )(x)
         # attention core in f32: the online softmax must not lose mass to
         # bf16 rounding (projections carry the compute dtype; the core is
-        # a small fraction of the FLOPs at these widths)
-        q, k, v = jnp.split(
-            qkv.reshape(b, s, 3 * h, hd).astype(jnp.float32), 3, axis=2
-        )
+        # a small fraction of the FLOPs at these widths).
+        # HEAD-MAJOR layout: the fused projection's output axis is ordered
+        # [h0(q,k,v), h1(q,k,v), ...] so a contiguous split across a
+        # `model` mesh axis (parallel/tensor.py column-parallel spec) puts
+        # each head's q, k AND v on the same device — attention stays
+        # head-local under tensor parallelism.
+        qkv = qkv.reshape(b, s, h, 3, hd).astype(jnp.float32)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         if impl in ("ring", "ring_flash"):
             # 'ring_flash' = same ring schedule with the Pallas flash
             # kernel as each step's block compute (two-level streaming:

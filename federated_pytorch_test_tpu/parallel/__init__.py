@@ -26,6 +26,13 @@ from federated_pytorch_test_tpu.parallel.multihost import (
     initialize_distributed,
     multihost_client_mesh,
 )
+from federated_pytorch_test_tpu.parallel.tensor import (
+    MODEL_AXIS,
+    client_model_mesh,
+    model_mesh,
+    shard_params_tp,
+    tp_param_specs,
+)
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
@@ -41,7 +48,12 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 __all__ = [
     "mark_varying",
     "CLIENT_AXIS",
+    "MODEL_AXIS",
     "SEQ_AXIS",
+    "client_model_mesh",
+    "model_mesh",
+    "shard_params_tp",
+    "tp_param_specs",
     "all_clients",
     "dense_attention",
     "ring_attention",

@@ -31,10 +31,12 @@ CLIENT_AXIS = "clients"
 PyTree = Any
 
 
-def client_mesh(
-    n_devices: int | None = None, devices: Sequence[jax.Device] | None = None
+def mesh_1d(
+    axis: str,
+    n_devices: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """A 1-D mesh over `n_devices` devices with the `clients` axis."""
+    """A 1-D mesh over `n_devices` devices with the given axis name."""
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         if n_devices > len(devs):
@@ -42,7 +44,34 @@ def client_mesh(
                 f"requested {n_devices} devices, only {len(devs)} available"
             )
         devs = devs[:n_devices]
-    return Mesh(np.asarray(devs), (CLIENT_AXIS,))
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def mesh_2d(
+    axes: tuple[str, str],
+    d_outer: int,
+    d_inner: int,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """A 2-D `(outer, inner)` mesh.
+
+    The inner axis is fastest-varying in device index = physically
+    adjacent on most topologies — put the latency/bandwidth-critical
+    collective pattern (ring `ppermute`, TP all-reduce) on it.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    need = d_outer * d_inner
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, only {len(devs)} available")
+    grid = np.asarray(devs[:need]).reshape(d_outer, d_inner)
+    return Mesh(grid, axes)
+
+
+def client_mesh(
+    n_devices: int | None = None, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """A 1-D mesh over `n_devices` devices with the `clients` axis."""
+    return mesh_1d(CLIENT_AXIS, n_devices, devices)
 
 
 def client_seq_mesh(
@@ -61,12 +90,7 @@ def client_seq_mesh(
     """
     from federated_pytorch_test_tpu.parallel.ring import SEQ_AXIS
 
-    devs = list(devices) if devices is not None else jax.devices()
-    need = d_clients * d_seq
-    if need > len(devs):
-        raise ValueError(f"requested {need} devices, only {len(devs)} available")
-    grid = np.asarray(devs[:need]).reshape(d_clients, d_seq)
-    return Mesh(grid, (CLIENT_AXIS, SEQ_AXIS))
+    return mesh_2d((CLIENT_AXIS, SEQ_AXIS), d_clients, d_seq, devices)
 
 
 def mesh_size(mesh: Mesh) -> int:

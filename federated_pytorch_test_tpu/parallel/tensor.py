@@ -1,0 +1,212 @@
+"""Tensor parallelism: a `model` mesh axis with Megatron-style shardings.
+
+The reference has no tensor parallelism (SURVEY.md §2.3: whole model per
+client) and round-2 merely reserved the axis name. This module makes TP a
+real capability, the TPU-idiomatic way: instead of hand-writing sharded
+matmul kernels (the GPU/Megatron route), parameters are annotated with
+`PartitionSpec`s over a named `model` mesh axis and XLA's SPMD partitioner
+derives the per-device program and inserts the collectives (all-reduce
+after row-parallel layers) — the scaling-book recipe of "pick a mesh,
+annotate shardings, let XLA insert collectives".
+
+The sharding rules are the Megatron alternation, keyed on the framework's
+own layer names (models/transformer.py, models/simple.py):
+
+  column-parallel (split output features):  qkv, fc1, head
+      kernel [in, out]  -> P(None, 'model');  bias [out] -> P('model')
+  row-parallel (split input features):      proj, fc2
+      kernel [in, out]  -> P('model', None);  bias [out] -> P()  (replicated;
+      XLA adds the psum over 'model' that completes the row-parallel matmul)
+  everything else (embeddings, positions, norms, convs) stays replicated:
+  P(). A column-parallel leaf whose axis does not divide by the mesh size
+  is demoted to replicated when a mesh is given (`tp_param_specs`) — small
+  classifier heads (ViT's 10-way `head`) stay whole while the network
+  around them shards.
+
+For `MultiHeadAttention` the `qkv` projection's output axis is HEAD-MAJOR
+([h0(q,k,v), h1(q,k,v), ...] — models/transformer.py), so the contiguous
+blocks of a `model`-axis split each hold whole heads with their q, k and
+v together: when d_model divides num_heads, attention is head-local and
+the `proj` all-reduce is the block's only cross-device traffic
+(asserted against the compiled forward HLO in tests/test_tensor.py).
+
+Composition with the federated axis: client-stacked `[K, ...]` trees get
+the `clients` axis prepended to every spec (`client_axis=True`), giving a
+2-D `(clients, model)` mesh — per-client TP shards ride the `model` axis
+while consensus collectives reduce over `clients`, on disjoint axes just
+like the `(clients, seq)` ring mesh (mesh.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS, mesh_1d, mesh_2d
+
+MODEL_AXIS = "model"
+
+PyTree = Any
+
+# layer name -> role in the Megatron alternation
+_COLUMN_PARALLEL = ("qkv", "fc1", "head")
+_ROW_PARALLEL = ("proj", "fc2")
+
+
+def model_mesh(d_model: int, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """A 1-D mesh over `d_model` devices with the `model` axis (pure TP)."""
+    return mesh_1d(MODEL_AXIS, d_model, devices)
+
+
+def client_model_mesh(
+    d_clients: int, d_model: int, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """A 2-D `(clients, model)` mesh: federated parallelism composed with
+    tensor parallelism.
+
+    `model` rides the inner (physically adjacent) axis: the per-layer
+    all-reduces of TP are latency-critical, while the per-round consensus
+    psum over `clients` is amortized across a whole epoch
+    (engine/steps.py) and can afford the longer strides.
+    """
+    return mesh_2d((CLIENT_AXIS, MODEL_AXIS), d_clients, d_model, devices)
+
+
+def _leaf_spec(path, ndim: int) -> P:
+    """Sharding spec for one param leaf, from its tree path and rank.
+
+    `ndim` is the rank of the leaf WITHOUT any leading client axis — the
+    caller strips it for client-stacked trees.
+    """
+    names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+    layer = next((n for n in names if n in _COLUMN_PARALLEL + _ROW_PARALLEL), None)
+    leaf_name = names[-1] if names else None
+    if layer is None:
+        return P()
+    if layer in _COLUMN_PARALLEL:
+        if leaf_name == "kernel" and ndim >= 2:
+            # [..., in, out] — split output features (conv kernels keep
+            # spatial dims leading, Dense kernels are [in, out]; either
+            # way the last axis is the output-feature axis)
+            return P(*([None] * (ndim - 1) + [MODEL_AXIS]))
+        if leaf_name == "bias" and ndim == 1:
+            return P(MODEL_AXIS)
+        return P()
+    # row-parallel: split input features; bias stays replicated (added
+    # after the all-reduce that completes the matmul)
+    if leaf_name == "kernel" and ndim >= 2:
+        return P(*([None] * (ndim - 2) + [MODEL_AXIS, None]))
+    return P()
+
+
+def tp_param_specs(
+    tree: PyTree, client_axis: bool = False, mesh: Mesh | None = None
+) -> PyTree:
+    """`PartitionSpec` tree matching `tree` under the Megatron rules above.
+
+    `client_axis=True` is for client-stacked `[K, ...]` trees
+    (models/base.py `init_client_params`): every spec gets the `clients`
+    axis prepended for the leading K dimension.
+
+    With a `mesh`, any leaf whose sharded axis does not divide evenly by
+    the mesh axis is demoted to replicated — the fallback that keeps small
+    classifier heads (e.g. ViT's 10-way `head`) whole while the rest of
+    the network shards. Without a mesh the specs are the pure rule table
+    (divisibility is then the caller's problem; see
+    `validate_tp_divisibility`).
+    """
+
+    if mesh is not None:
+        for axis, builder in (
+            (MODEL_AXIS, "model_mesh()/client_model_mesh()"),
+            (CLIENT_AXIS, "client_model_mesh()"),
+        ):
+            if axis not in mesh.shape and (axis == MODEL_AXIS or client_axis):
+                raise ValueError(
+                    f"mesh {tuple(mesh.axis_names)} has no {axis!r} axis — "
+                    f"build it with {builder}"
+                )
+
+    def spec(path, leaf):
+        s = _leaf_spec(path, leaf.ndim - 1 if client_axis else leaf.ndim)
+        if mesh is not None and not _divides(leaf.shape[1:] if client_axis else leaf.shape, s, mesh):
+            s = P()
+        if client_axis:
+            if mesh is not None and leaf.shape[0] % mesh.shape[CLIENT_AXIS] != 0:
+                # the K axis cannot be demoted — replicating it would turn
+                # client parallelism off behind the caller's back
+                raise ValueError(
+                    f"leading client axis of length {leaf.shape[0]} "
+                    f"(param {jax.tree_util.keystr(path)}) is not "
+                    f"divisible by the mesh's clients axis "
+                    f"(size {mesh.shape[CLIENT_AXIS]})"
+                )
+            # pad to full rank so the leading K axis maps to `clients`
+            # and the layer's own axes keep their Megatron placement
+            s = P(CLIENT_AXIS, *(tuple(s) + (None,) * (leaf.ndim - 1 - len(s))))
+        return s
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def _divides(shape, spec: P, mesh: Mesh) -> bool:
+    return all(
+        axis is None or dim % mesh.shape[axis] == 0
+        for dim, axis in zip(shape, tuple(spec))
+    )
+
+
+def validate_tp_divisibility(tree: PyTree, specs: PyTree, mesh: Mesh) -> None:
+    """Raise if any sharded axis length is not divisible by its mesh axis.
+
+    XLA would silently pad uneven shards; for the fixed model zoo here an
+    uneven split always means a wrong `d_model` choice (e.g. the qkv
+    output axis is 3*dim — `d_model` must divide it), so fail loudly.
+    """
+
+    def check(path, leaf, spec):
+        for dim, axis in zip(leaf.shape, tuple(spec)):
+            if axis is None:
+                continue
+            size = mesh.shape[axis]
+            if dim % size != 0:
+                raise ValueError(
+                    f"param {jax.tree_util.keystr(path)} axis of length "
+                    f"{dim} is not divisible by mesh axis {axis!r} "
+                    f"(size {size})"
+                )
+
+    jax.tree_util.tree_map_with_path(check, tree, specs)
+
+
+def shard_params_tp(
+    tree: PyTree, mesh: Mesh, client_axis: bool = False
+) -> PyTree:
+    """device_put every leaf according to its Megatron spec on `mesh`.
+
+    Leaves that cannot split evenly (small classifier heads) stay
+    replicated (see `tp_param_specs`); if NOTHING shards, `d_model` is
+    simply wrong for this model and the call raises instead of silently
+    running fully replicated.
+
+    Under `jit`, computation on the result is partitioned by sharding
+    propagation from these placements — no shard_map or manual collective
+    is needed; the all-reduces appear where the row-parallel layers need
+    them (tested against the compiled HLO in tests/test_tensor.py).
+    """
+    specs = tp_param_specs(tree, client_axis=client_axis, mesh=mesh)
+    d_model = mesh.shape[MODEL_AXIS]
+    if d_model > 1 and not any(
+        MODEL_AXIS in tuple(s) for s in jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P))
+    ):
+        raise ValueError(
+            f"no parameter axis of this model divides by d_model={d_model}; "
+            "every leaf would be replicated — pick a d_model that divides "
+            "the hidden sizes (e.g. the qkv output axis)"
+        )
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )

@@ -1,0 +1,236 @@
+"""Tensor-parallelism tests on the 8-device virtual CPU mesh.
+
+The TP contract (parallel/tensor.py): Megatron-annotated params on a
+`model` mesh axis give (a) genuinely distributed parameter storage,
+(b) bit-compatible numerics with the replicated model, and (c) XLA-
+inserted collectives — no shard_map, no manual psum.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.models import TransformerLM
+from federated_pytorch_test_tpu.models.base import init_client_params
+from federated_pytorch_test_tpu.parallel import CLIENT_AXIS
+from federated_pytorch_test_tpu.parallel.tensor import (
+    MODEL_AXIS,
+    client_model_mesh,
+    model_mesh,
+    shard_params_tp,
+    tp_param_specs,
+    validate_tp_divisibility,
+)
+
+pytestmark = pytest.mark.smoke  # fast CI tier
+
+
+def _lm():
+    return TransformerLM(vocab=64, dim=64, num_heads=4, max_len=32)
+
+
+def _init(model, seed=0):
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), tokens)["params"], tokens
+
+
+def _loss(model, params, tokens):
+    logits = model.apply({"params": params}, tokens)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def test_tp_specs_follow_megatron_alternation():
+    model = _lm()
+    params, _ = _init(model)
+    specs = tp_param_specs(params)
+    blk = specs["block0"]
+    # column-parallel: output features split, bias split
+    assert tuple(blk["attn"]["qkv"]["kernel"]) == (None, MODEL_AXIS)
+    assert tuple(blk["attn"]["qkv"]["bias"]) == (MODEL_AXIS,)
+    assert tuple(blk["fc1"]["kernel"]) == (None, MODEL_AXIS)
+    # row-parallel: input features split, bias replicated
+    assert tuple(blk["attn"]["proj"]["kernel"]) == (MODEL_AXIS, None)
+    assert tuple(blk["attn"]["proj"]["bias"]) == ()
+    assert tuple(blk["fc2"]["kernel"]) == (MODEL_AXIS, None)
+    # embeddings / norms replicated
+    assert tuple(specs["embed"]["embedding"]) == ()
+    assert tuple(specs["pos_embed"]) == ()
+    assert tuple(blk["ln1"]["scale"]) == ()
+
+
+def test_tp_params_are_distributed():
+    model = _lm()
+    params, _ = _init(model)
+    mesh = model_mesh(4)
+    sharded = shard_params_tp(params, mesh)
+    qkv = sharded["block0"]["attn"]["qkv"]["kernel"]
+    # each device holds 1/4 of the output features
+    shapes = {s.data.shape for s in qkv.addressable_shards}
+    assert shapes == {(64, 3 * 64 // 4)}
+    ln = sharded["block0"]["ln1"]["scale"]
+    assert {s.data.shape for s in ln.addressable_shards} == {(64,)}
+
+
+def test_tp_divisibility_is_validated():
+    model = TransformerLM(vocab=64, dim=64, num_heads=4, max_len=32)
+    params, _ = _init(model)
+    mesh = model_mesh(5)  # 192 qkv outputs % 5 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_tp_divisibility(params, tp_param_specs(params), mesh)
+
+
+@pytest.mark.parametrize("d_model", [2, 4, 8])
+def test_tp_forward_and_grads_match_replicated(d_model):
+    model = _lm()
+    params, tokens = _init(model)
+    ref_logits = model.apply({"params": params}, tokens)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: _loss(model, p, tokens)
+    )(params)
+
+    mesh = model_mesh(d_model)
+    sharded = shard_params_tp(params, mesh)
+    tp_logits = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+        sharded, tokens
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp_logits), np.asarray(ref_logits), atol=2e-5, rtol=1e-5
+    )
+    tp_loss, tp_grads = jax.jit(
+        jax.value_and_grad(lambda p, t: _loss(model, p, t))
+    )(sharded, tokens)
+    np.testing.assert_allclose(float(tp_loss), float(ref_loss), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=1e-4
+        ),
+        tp_grads,
+        ref_grads,
+    )
+    # gradient shardings follow the param shardings (the update stays local)
+    gq = tp_grads["block0"]["attn"]["qkv"]["kernel"]
+    assert {s.data.shape for s in gq.addressable_shards} == {
+        (64, 3 * 64 // d_model)
+    }
+
+
+def test_tp_inserts_collectives_where_row_parallel_needs_them():
+    model = _lm()
+    params, tokens = _init(model)
+    mesh = model_mesh(4)
+
+    def fwd_hlo(p):
+        return (
+            jax.jit(lambda p, t: model.apply({"params": p}, t))
+            .lower(p, tokens)
+            .compile()
+            .as_text()
+        )
+
+    # negative control: fully replicated params compile to a forward with
+    # no cross-device traffic at all
+    from federated_pytorch_test_tpu.parallel import replicate
+
+    hlo_repl = fwd_hlo(replicate(params, mesh))
+    assert "all-reduce" not in hlo_repl and "all-gather" not in hlo_repl
+
+    # Megatron shardings: the row-parallel completions (proj/fc2) force
+    # cross-device reduces into the same forward (XLA may lower some as
+    # reduce-scatter+all-gather pairs)
+    hlo_tp = fwd_hlo(shard_params_tp(params, mesh))
+    assert "all-reduce" in hlo_tp or "reduce-scatter" in hlo_tp
+
+
+def test_tp_head_major_qkv_keeps_attention_local():
+    # d_model=4 divides num_heads=4: the head-major qkv layout means every
+    # device holds whole heads (q,k,v together), so the forward needs NO
+    # all-gather — the row-parallel all-reduces are the only collective
+    # traffic. This is the discriminating assert: with the old
+    # [q-heads, k-heads, v-heads] layout XLA must regather k/v before
+    # attention and an all-gather (or all-to-all) appears.
+    model = _lm()
+    params, tokens = _init(model)
+    mesh = model_mesh(4)
+    hlo = (
+        jax.jit(lambda p, t: model.apply({"params": p}, t))
+        .lower(shard_params_tp(params, mesh), tokens)
+        .compile()
+        .as_text()
+    )
+    assert "all-reduce" in hlo
+    assert "all-to-all" not in hlo
+    # no k/v regather. XLA may legitimately lower an all-reduce as a
+    # reduce-scatter+all-gather pair, so a bare "no all-gather" would be a
+    # latent flake — an UNPAIRED all-gather is what betrays a regather.
+    assert hlo.count("all-gather") == hlo.count("reduce-scatter")
+
+
+def test_tp_small_classifier_head_stays_replicated():
+    from federated_pytorch_test_tpu.models import ViT
+
+    model = ViT(num_classes=10, dim=64, num_heads=4)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
+    )["params"]
+    mesh = model_mesh(4)
+    sharded = shard_params_tp(params, mesh)  # must not raise
+    # the 10-way head cannot split by 4 -> replicated whole on every device
+    head = sharded["head"]["kernel"]
+    assert {s.data.shape for s in head.addressable_shards} == {(64, 10)}
+    # while the blocks around it still shard
+    fc1 = sharded["block0"]["fc1"]["kernel"]
+    assert {s.data.shape for s in fc1.addressable_shards} == {(64, 256 // 4)}
+
+
+def test_tp_client_axis_mismatch_fails_loudly():
+    # K not divisible by the mesh's clients axis cannot be demoted
+    # (replicating K would silently turn client parallelism off) — it must
+    # be the module's clear error, not a raw device_put failure
+    model = _lm()
+    stacked = init_client_params(model, 3)["params"]
+    with pytest.raises(ValueError, match="clients axis"):
+        shard_params_tp(stacked, client_model_mesh(2, 4), client_axis=True)
+
+
+def test_tp_rejects_mesh_that_shards_nothing():
+    model = _lm()
+    params, _ = _init(model)
+    with pytest.raises(ValueError, match="no parameter axis"):
+        shard_params_tp(params, model_mesh(7))
+
+
+def test_tp_rejects_mesh_without_model_axis():
+    from federated_pytorch_test_tpu.parallel import client_mesh
+
+    model = _lm()
+    params, _ = _init(model)
+    with pytest.raises(ValueError, match="no 'model' axis"):
+        shard_params_tp(params, client_mesh(4))
+
+
+def test_tp_composes_with_client_axis():
+    k, d_clients, d_model = 4, 2, 4
+    model = _lm()
+    stacked = init_client_params(model, k)["params"]
+    # differentiate the clients so the test discriminates axis mix-ups
+    stacked = jax.tree.map(
+        lambda x: x * (1 + 0.1 * jnp.arange(k, dtype=x.dtype).reshape(
+            (k,) + (1,) * (x.ndim - 1))),
+        stacked,
+    )
+    tokens = jnp.tile(jnp.arange(16, dtype=jnp.int32)[None], (k, 2, 1))
+
+    ref = jax.vmap(lambda p, t: model.apply({"params": p}, t))(stacked, tokens)
+
+    mesh = client_model_mesh(d_clients, d_model)
+    assert mesh.shape[CLIENT_AXIS] == d_clients
+    sharded = shard_params_tp(stacked, mesh, client_axis=True)
+    out = jax.jit(
+        jax.vmap(lambda p, t: model.apply({"params": p}, t))
+    )(sharded, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
+    )
