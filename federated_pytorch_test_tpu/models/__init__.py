@@ -12,6 +12,14 @@ order — replacing the reference's `linear_layer_ids` /
 """
 
 from federated_pytorch_test_tpu.models.base import PartitionedModel, init_client_params
+from federated_pytorch_test_tpu.models.moe import (
+    EXPERT_AXIS,
+    MoEMLP,
+    client_expert_mesh,
+    ep_param_specs,
+    expert_mesh,
+    shard_params_ep,
+)
 from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
 from federated_pytorch_test_tpu.models.resnet import ResNet18
 from federated_pytorch_test_tpu.models.transformer import TransformerLM, ViT
@@ -28,6 +36,8 @@ MODELS = {
 }
 
 __all__ = [
+    "EXPERT_AXIS",
+    "MoEMLP",
     "Net",
     "Net1",
     "Net2",
@@ -35,6 +45,10 @@ __all__ = [
     "TransformerLM",
     "ViT",
     "PartitionedModel",
+    "client_expert_mesh",
+    "ep_param_specs",
+    "expert_mesh",
     "init_client_params",
+    "shard_params_ep",
     "MODELS",
 ]

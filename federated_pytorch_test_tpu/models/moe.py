@@ -1,0 +1,148 @@
+"""Mixture-of-experts layer with expert parallelism over an `experts` axis.
+
+Neither the reference nor SURVEY.md asks for MoE (SURVEY §2.3 lists expert
+parallelism as absent/non-goal); this module completes the framework's
+parallelism families so every axis the mesh design reserved is a real,
+tested capability: clients (mesh.py), seq (ring.py), model (tensor.py),
+stages (pipeline.py), experts (here).
+
+`MoEMLP` is a switch-style top-1 routed MLP (one gate projection, E
+expert MLPs, capacity-bounded dispatch) designed for XLA:
+
+- routing is dense one-hot einsums (the Shazeer dispatch/combine masks),
+  so there is no data-dependent control flow and the whole layer jits
+  to static shapes;
+- capacity C = ceil(tokens/E * capacity_factor) bounds every expert's
+  work; tokens over capacity fall through the residual (their combine
+  weight is zero), the standard switch-transformer overflow semantics;
+- expert weights are stacked `[E, ...]` leaves, vmapped over E — the
+  expert-parallel layout is a SHARDING of that axis, not different code.
+
+Expert parallelism follows the tensor.py idiom and lives with the other
+axes' mesh/sharding helpers (parallel/expert.py, re-exported here):
+`ep_param_specs` returns `PartitionSpec('experts', ...)` for every
+stacked expert leaf (gate and non-expert params replicated),
+`shard_params_ep` device_puts them on an `expert_mesh`/
+`client_expert_mesh`, and XLA's SPMD partitioner slices the vmapped
+expert compute per device and inserts the combine collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import bias_init, kernel_init
+
+# the axis's mesh/sharding idiom lives with the other axes' in parallel/
+from federated_pytorch_test_tpu.parallel.expert import (  # noqa: F401
+    EXPERT_AXIS,
+    client_expert_mesh,
+    ep_param_specs,
+    expert_mesh,
+    shard_params_ep,
+)
+
+PyTree = Any
+
+
+class MoEMLP(nn.Module):
+    """Switch-style top-1 MoE MLP, drop-in for a transformer block's MLP.
+
+    Token t routes to expert argmax(gate(x_t)); its output is the chosen
+    expert's MLP scaled by the gate probability (so routing receives
+    gradient).
+
+    The switch load-balance term E * Σ_e (fraction_e · prob_e) (minimized
+    at uniform routing) is ALWAYS sown into the `intermediates` collection
+    under `"moe_aux"`, so it is reachable through any wrapping model —
+    e.g. `TransformerLM(moe_experts=E)`:
+
+        logits, mut = lm.apply(vars, tokens, mutable=["intermediates"])
+        aux = sum(jax.tree.leaves(mut["intermediates"]))
+        loss = ce(logits) + 0.01 * aux
+
+    With `return_aux=True` the layer also returns it directly as a second
+    output (the standalone-layer API).
+    """
+
+    dim: int
+    n_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    return_aux: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        b, s, d = x.shape
+        t = b * s
+        e = self.n_experts
+        cap = max(1, int(math.ceil(t / e * self.capacity_factor)))
+        xt = x.reshape(t, d)
+
+        # --- routing (always f32: softmax over few logits, cheap) ---
+        logits = nn.Dense(
+            e, name="gate", kernel_init=kernel_init, bias_init=bias_init,
+            dtype=jnp.float32,
+        )(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+
+        # capacity: token's slot within its expert; over-capacity tokens
+        # get combine weight 0 (they ride the residual connection)
+        pos = jnp.cumsum(onehot, axis=0) - 1.0  # [T, E] position per expert
+        pos_t = jnp.sum(pos * onehot, axis=1)  # [T]
+        keep = (pos_t < cap).astype(jnp.float32)
+        slot = jax.nn.one_hot(
+            pos_t.astype(jnp.int32), cap, dtype=jnp.float32
+        )  # [T, C]
+
+        # dispatch/combine masks (dense einsums, XLA-friendly)
+        dispatch = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        # [T, E, C]
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch, xt.astype(jnp.float32)
+        ).astype(self.dtype)  # [E, C, D]
+
+        # --- expert MLPs: stacked [E, ...] params, vmapped over E ---
+        h = self.mlp_ratio * d
+
+        def mlp(x_e, w1, b1, w2, b2):
+            y = jnp.einsum("cd,dh->ch", x_e, w1) + b1
+            y = nn.gelu(y)
+            return jnp.einsum("ch,hd->cd", y, w2) + b2
+
+        w1 = self.param(
+            "w1", nn.initializers.xavier_uniform(), (e, d, h), jnp.float32
+        ).astype(self.dtype)
+        b1 = self.param(
+            "b1", nn.initializers.constant(0.01), (e, h), jnp.float32
+        ).astype(self.dtype)
+        w2 = self.param(
+            "w2", nn.initializers.xavier_uniform(), (e, h, d), jnp.float32
+        ).astype(self.dtype)
+        b2 = self.param(
+            "b2", nn.initializers.constant(0.01), (e, d), jnp.float32
+        ).astype(self.dtype)
+        expert_out = jax.vmap(mlp)(expert_in, w1, b1, w2, b2)  # [E, C, D]
+
+        combine = dispatch * gate[:, None, None]  # [T, E, C]
+        out = jnp.einsum(
+            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+        )
+        out = out.reshape(b, s, d).astype(self.dtype)
+        # switch load-balance loss: E * Σ_e mean(onehot_e) * mean(prob_e)
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(frac * mean_prob)
+        self.sow("intermediates", "moe_aux", aux)
+        if not self.return_aux:
+            return out
+        return out, aux

@@ -134,7 +134,12 @@ class MultiHeadAttention(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm encoder block: LN -> MHA -> +res; LN -> MLP -> +res."""
+    """Pre-norm encoder block: LN -> MHA -> +res; LN -> MLP -> +res.
+
+    `moe_experts > 0` swaps the dense MLP for a switch-style top-1 MoE
+    with that many experts (models/moe.py); over-capacity tokens ride
+    this block's residual connection.
+    """
 
     dim: int
     num_heads: int
@@ -142,6 +147,7 @@ class Block(nn.Module):
     attn_impl: str = "dense"
     causal: bool = False
     attn_precision: Any = None
+    moe_experts: int = 0
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -157,18 +163,29 @@ class Block(nn.Module):
             name="attn",
         )(y)
         y = nn.LayerNorm(name="ln2", dtype=self.dtype)(x)
-        y = nn.Dense(
-            self.mlp_ratio * self.dim,
-            name="fc1",
-            kernel_init=kernel_init,
-            bias_init=bias_init,
-            dtype=self.dtype,
-        )(y)
-        y = nn.gelu(y)
-        y = nn.Dense(
-            self.dim, name="fc2", kernel_init=kernel_init,
-            bias_init=bias_init, dtype=self.dtype,
-        )(y)
+        if self.moe_experts:
+            from federated_pytorch_test_tpu.models.moe import MoEMLP
+
+            y = MoEMLP(
+                self.dim,
+                self.moe_experts,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+                name="moe",
+            )(y)
+        else:
+            y = nn.Dense(
+                self.mlp_ratio * self.dim,
+                name="fc1",
+                kernel_init=kernel_init,
+                bias_init=bias_init,
+                dtype=self.dtype,
+            )(y)
+            y = nn.gelu(y)
+            y = nn.Dense(
+                self.dim, name="fc2", kernel_init=kernel_init,
+                bias_init=bias_init, dtype=self.dtype,
+            )(y)
         return x + y
 
 
@@ -205,6 +222,7 @@ class TransformerLM(PartitionedModel):
     max_len: int = 2048
     attn_impl: str = "dense"
     attn_precision: Any = None
+    moe_experts: int = 0  # >0: switch-MoE MLPs (models/moe.py)
 
     @classmethod
     def input_shape(cls):
@@ -246,6 +264,7 @@ class TransformerLM(PartitionedModel):
                 attn_impl=self.attn_impl,
                 causal=True,
                 attn_precision=self.attn_precision,
+                moe_experts=self.moe_experts,
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x)

@@ -26,6 +26,21 @@ from federated_pytorch_test_tpu.parallel.multihost import (
     initialize_distributed,
     multihost_client_mesh,
 )
+from federated_pytorch_test_tpu.parallel.expert import (
+    EXPERT_AXIS,
+    client_expert_mesh,
+    ep_param_specs,
+    expert_mesh,
+    shard_params_ep,
+)
+from federated_pytorch_test_tpu.parallel.pipeline import (
+    STAGE_AXIS,
+    client_stage_mesh,
+    pipeline_apply,
+    spmd_pipeline,
+    stack_stage_params,
+    stage_mesh,
+)
 from federated_pytorch_test_tpu.parallel.tensor import (
     MODEL_AXIS,
     client_model_mesh,
@@ -48,8 +63,19 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 __all__ = [
     "mark_varying",
     "CLIENT_AXIS",
+    "EXPERT_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
+    "STAGE_AXIS",
+    "client_expert_mesh",
+    "ep_param_specs",
+    "expert_mesh",
+    "shard_params_ep",
+    "client_stage_mesh",
+    "pipeline_apply",
+    "spmd_pipeline",
+    "stack_stage_params",
+    "stage_mesh",
     "client_model_mesh",
     "model_mesh",
     "shard_params_tp",

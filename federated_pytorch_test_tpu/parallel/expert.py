@@ -1,0 +1,84 @@
+"""Expert-parallelism meshes and shardings (the `experts` axis).
+
+The MoE layer itself lives with the models (models/moe.py `MoEMLP`); this
+module is the axis's mesh/sharding idiom, in the same place and shape as
+every other axis's: `mesh.py` (clients), `ring.py` (seq), `tensor.py`
+(model), `pipeline.py` (stages). Expert weights are stacked `[E, ...]`
+leaves; expert parallelism is a SHARDING of that axis (GSPMD partitions
+the vmapped expert compute and inserts the combine collectives), so these
+helpers only need names and shapes — they never import the model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS, mesh_1d, mesh_2d
+
+EXPERT_AXIS = "experts"
+
+PyTree = Any
+
+# MoEMLP's stacked expert leaves (models/moe.py); the gate and every
+# non-expert param stay replicated
+_EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
+
+
+def expert_mesh(d_experts: int, devices=None) -> Mesh:
+    """A 1-D mesh over `d_experts` devices with the `experts` axis."""
+    return mesh_1d(EXPERT_AXIS, d_experts, devices)
+
+
+def client_expert_mesh(d_clients: int, d_experts: int, devices=None) -> Mesh:
+    """A 2-D `(clients, experts)` mesh: per-client expert pools."""
+    return mesh_2d((CLIENT_AXIS, EXPERT_AXIS), d_clients, d_experts, devices)
+
+
+def ep_param_specs(tree: PyTree, n_experts: int, client_axis: bool = False) -> PyTree:
+    """`PartitionSpec` tree sharding stacked expert leaves on `experts`.
+
+    A leaf is an expert stack iff its leading axis (after any client axis)
+    equals `n_experts` AND its leaf name is one of MoEMLP's expert params
+    (w1/b1/w2/b2). With `client_axis=True` (stacked `[K, ...]` trees)
+    every spec gets the `clients` axis prepended.
+    """
+
+    def spec(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        shape = leaf.shape[1:] if client_axis else leaf.shape
+        s = P()
+        if names and names[-1] in _EXPERT_LEAVES and shape and shape[0] == n_experts:
+            s = P(EXPERT_AXIS)
+        if client_axis:
+            s = P(CLIENT_AXIS, *tuple(s))
+        return s
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def shard_params_ep(
+    tree: PyTree, mesh: Mesh, n_experts: int, client_axis: bool = False
+) -> PyTree:
+    """device_put expert stacks sharded on the mesh's `experts` axis.
+
+    `n_experts` must divide by the axis size (each device owns a whole
+    block of experts); everything else is replicated.
+    """
+    if EXPERT_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh {tuple(mesh.axis_names)} has no {EXPERT_AXIS!r} axis — "
+            "build it with expert_mesh()/client_expert_mesh()"
+        )
+    de = mesh.shape[EXPERT_AXIS]
+    if n_experts % de != 0:
+        raise ValueError(
+            f"n_experts={n_experts} not divisible by the mesh's experts "
+            f"axis (size {de})"
+        )
+    specs = ep_param_specs(tree, n_experts, client_axis=client_axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )

@@ -1,0 +1,171 @@
+"""Pipeline parallelism: a `stages` mesh axis with an SPMD ppermute pipeline.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3 calls the
+layer-partition round-robin "a scheduling cousin" and asks that the
+partition abstraction stay orthogonal to the mesh so PP could reuse it).
+This module supplies the real thing, the TPU-idiomatic way: consecutive
+layer stages live on consecutive devices of a named `stages` mesh axis,
+microbatches stream through inside ONE jitted `shard_map` — each cycle
+every device applies its stage and hands its activation to the next device
+with a single `lax.ppermute` hop over ICI, and a `lax.scan` drives the
+M + S - 1 cycles. No host round-trips, no per-stage programs: the whole
+pipeline (bubbles included) is one XLA program, and `jax.grad` through it
+yields the reverse pipeline automatically (the transpose of `ppermute` is
+the reverse permutation, the transpose of the scan is the backward sweep).
+
+This is the standard SPMD pipelining trade: every device computes every
+cycle, so S·(M+S-1) stage applications run for M·S useful ones — the
+bubble fraction is (S-1)/(M+S-1); raise the microbatch count M to
+amortize it.
+
+Composition: the `stages` axis is just another mesh axis, so
+`client_stage_mesh(dc, ds)` runs one pipeline per client block while
+consensus collectives reduce over `clients` — the same disjoint-axes
+pattern as `(clients, seq)` ring attention and `(clients, model)` tensor
+parallelism (mesh.py, tensor.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    mesh_1d,
+    mesh_2d,
+)
+
+STAGE_AXIS = "stages"
+
+PyTree = Any
+
+
+def stage_mesh(d_stages: int, devices=None) -> Mesh:
+    """A 1-D mesh over `d_stages` devices with the `stages` axis."""
+    return mesh_1d(STAGE_AXIS, d_stages, devices)
+
+
+def client_stage_mesh(d_clients: int, d_stages: int, devices=None) -> Mesh:
+    """A 2-D `(clients, stages)` mesh: one pipeline per client block.
+
+    `stages` rides the inner (physically adjacent) axis — the per-cycle
+    ppermute hop is the latency-critical pattern.
+    """
+    return mesh_2d((CLIENT_AXIS, STAGE_AXIS), d_clients, d_stages, devices)
+
+
+def stack_stage_params(stage_params: Sequence[PyTree]) -> PyTree:
+    """Stack S per-stage param trees into one `[S, ...]`-leaved tree.
+
+    The stages must be structurally identical (e.g. S equal transformer
+    blocks); the stacked tree is what gets sharded on the `stages` axis.
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def spmd_pipeline(
+    fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    params: PyTree,
+    xs: jnp.ndarray,
+    axis_name: str = STAGE_AXIS,
+) -> jnp.ndarray:
+    """Run microbatches `xs` through the stage pipeline. CALL INSIDE a
+    `shard_map` that binds `axis_name` (see `pipeline_apply` for the
+    self-contained entry point).
+
+    fn:     `(one_stage_params, x_micro) -> y_micro`, output shaped like
+            the input (homogeneous stages — transformer blocks qualify).
+    params: this device's stage params with a leading local axis of size 1
+            (the `[S, ...]` stacked tree sharded on `axis_name`).
+    xs:     `[M, ...]` microbatches, replicated (only stage 0 reads them).
+
+    Returns `[M, ...]` outputs, replicated across the axis (a psum
+    broadcast of the last stage's collection buffer).
+    """
+    stage = lax.axis_index(axis_name)
+    n_stages = lax.psum(1, axis_name)
+    m = xs.shape[0]
+    p = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+
+    def cycle(carry, t):
+        state, outbuf = carry
+        # stage 0 injects microbatch t (clamped once the stream runs dry;
+        # those cycles' results are masked out of the collection below)
+        x_t = lax.dynamic_index_in_dim(
+            xs, jnp.minimum(t, m - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(stage == 0, x_t, state)
+        out = fn(p, inp)
+        # last stage finishes microbatch t-(S-1) at cycle t
+        idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+        take = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        prev = lax.dynamic_index_in_dim(outbuf, idx, axis=0, keepdims=False)
+        outbuf = lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(take, out, prev), idx, axis=0
+        )
+        # hand the activation to the next stage (one ICI hop); the wrap
+        # from last->first carries garbage that stage 0 overwrites
+        state = _shift_forward(out, axis_name)
+        return (state, outbuf), None
+
+    # constant-initialized carries become device-varying after one cycle
+    # (ppermute / stage-masked writes) — promote them up front so the
+    # scan's vma fixpoint sees invariant carry types (see ring.py)
+    from federated_pytorch_test_tpu.parallel.ring import mark_varying
+
+    state0 = mark_varying(jnp.zeros_like(xs[0]), axis_name)
+    outbuf0 = mark_varying(jnp.zeros_like(xs), axis_name)
+    (_, outbuf), _ = lax.scan(
+        cycle, (state0, outbuf0), jnp.arange(m + _static_axis_size(axis_name) - 1)
+    )
+    # only the last stage holds real outputs; psum broadcasts them (every
+    # other stage contributes zeros)
+    return lax.psum(jnp.where(stage == n_stages - 1, outbuf, 0.0), axis_name)
+
+
+def _static_axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)  # static under shard_map
+
+
+def _shift_forward(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    n = _static_axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def pipeline_apply(
+    fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    stacked_params: PyTree,
+    xs: jnp.ndarray,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Self-contained jittable entry point: shard `[S, ...]` params on the
+    mesh's `stages` axis and stream `[M, ...]` microbatches through.
+
+    Differentiable end-to-end; the returned `[M, ...]` outputs equal the
+    sequential composition of the stages (tested in tests/test_pipeline.py).
+    """
+    s = mesh.shape[STAGE_AXIS]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != s:
+        raise ValueError(
+            f"stacked params carry {lead} stages but the mesh's "
+            f"{STAGE_AXIS!r} axis has {s} devices — they must match "
+            "(one stage per device)"
+        )
+    pspec = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
+
+    from jax import shard_map
+
+    run = shard_map(
+        lambda prm, x: spmd_pipeline(fn, prm, x),
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+    )
+    return run(stacked_params, xs)

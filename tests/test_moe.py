@@ -1,0 +1,199 @@
+"""MoE layer + expert-parallelism tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.models.moe import (
+    EXPERT_AXIS,
+    MoEMLP,
+    client_expert_mesh,
+    ep_param_specs,
+    expert_mesh,
+    shard_params_ep,
+)
+
+pytestmark = pytest.mark.smoke  # fast CI tier
+
+DIM, E = 8, 4
+
+
+def _layer(**kw):
+    return MoEMLP(dim=DIM, n_experts=E, mlp_ratio=2, **kw)
+
+
+def _init(layer, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(b, s, DIM)), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(seed), x)["params"]
+    return params, x
+
+
+def test_moe_matches_manual_top1_routing():
+    # ample capacity: every token must get gate_prob * mlp_{argmax}(x)
+    layer = _layer(capacity_factor=float(E))  # capacity == tokens
+    params, x = _init(layer)
+    out = layer.apply({"params": params}, x)
+    xt = np.asarray(x).reshape(-1, DIM)
+    logits = xt @ np.asarray(params["gate"]["kernel"]) + np.asarray(
+        params["gate"]["bias"]
+    )
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    idx = probs.argmax(-1)
+    w1, b1 = np.asarray(params["w1"]), np.asarray(params["b1"])
+    w2, b2 = np.asarray(params["w2"]), np.asarray(params["b2"])
+    want = np.stack([
+        probs[t, idx[t]] * (
+            np.asarray(jax.nn.gelu(jnp.asarray(xt[t] @ w1[idx[t]] + b1[idx[t]])))
+            @ w2[idx[t]] + b2[idx[t]]
+        )
+        for t in range(xt.shape[0])
+    ]).reshape(np.asarray(out).shape)
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5, rtol=1e-5)
+
+
+def test_moe_capacity_overflow_rides_residual():
+    # capacity 1 with 32 tokens: most tokens overflow and contribute 0
+    # (block residual carries them); kept tokens still get routed output
+    layer = _layer(capacity_factor=1.0 / 8)
+    params, x = _init(layer, s=16)
+    out = np.asarray(layer.apply({"params": params}, x)).reshape(-1, DIM)
+    zero_rows = np.sum(np.all(np.abs(out) < 1e-12, axis=1))
+    # E experts x capacity ceil(32/4 * 1/8)=1 slot => at most E nonzero rows
+    assert zero_rows >= out.shape[0] - E
+
+
+def test_moe_aux_loss_is_one_at_uniform_routing():
+    layer = _layer(return_aux=True, capacity_factor=float(E))
+    params, x = _init(layer)
+    # zero the gate: uniform probs, aux == E * sum(frac_e * 1/E) == 1
+    params = jax.tree.map(np.zeros_like, params)
+    _, aux = layer.apply({"params": params}, x)
+    assert abs(float(aux) - 1.0) < 1e-6
+
+
+def test_ep_specs_shard_only_expert_stacks():
+    layer = _layer()
+    params, _ = _init(layer)
+    specs = ep_param_specs(params, E)
+    assert tuple(specs["w1"]) == (EXPERT_AXIS,)
+    assert tuple(specs["w2"]) == (EXPERT_AXIS,)
+    assert tuple(specs["b1"]) == (EXPERT_AXIS,)
+    assert tuple(specs["gate"]["kernel"]) == ()
+    assert tuple(specs["gate"]["bias"]) == ()
+
+
+@pytest.mark.parametrize("de", [2, 4])
+def test_ep_forward_and_grads_match_replicated(de):
+    layer = _layer(capacity_factor=2.0)
+    params, x = _init(layer, seed=3)
+
+    def loss(p, xx):
+        return jnp.mean(layer.apply({"params": p}, xx) ** 2)
+
+    ref_l, ref_g = jax.value_and_grad(loss)(params, x)
+    mesh = expert_mesh(de)
+    sh = shard_params_ep(params, mesh, E)
+    # expert stacks are distributed, E/de experts per device
+    assert {s.data.shape[0] for s in sh["w1"].addressable_shards} == {E // de}
+    tp_l, tp_g = jax.jit(jax.value_and_grad(loss))(sh, x)
+    np.testing.assert_allclose(float(tp_l), float(ref_l), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=1e-4
+        ),
+        tp_g,
+        ref_g,
+    )
+
+
+def test_ep_composes_with_client_axis():
+    layer = _layer(capacity_factor=2.0)
+    params, x = _init(layer, seed=4)
+    k = 2
+    stacked = jax.tree.map(
+        lambda a: jnp.stack([a, 1.5 * a]), params
+    )
+    xs = jnp.stack([x, x[:, ::-1]])
+    ref = jax.vmap(lambda p, xx: layer.apply({"params": p}, xx))(stacked, xs)
+    mesh = client_expert_mesh(k, 4)
+    sh = shard_params_ep(stacked, mesh, E, client_axis=True)
+    out = jax.jit(
+        jax.vmap(lambda p, xx: layer.apply({"params": p}, xx))
+    )(sh, xs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_moe_transformer_lm_trains_end_to_end():
+    # the model-family wiring: TransformerLM(moe_experts=E) routes every
+    # block's MLP through the switch layer and still backprops; expert
+    # stacks appear under block*/moe and shard with ep_param_specs
+    from federated_pytorch_test_tpu.models import TransformerLM
+
+    lm = TransformerLM(vocab=32, dim=16, num_heads=2, max_len=16,
+                       moe_experts=E)
+    tokens = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    params = lm.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert "moe" in params["block0"] and "w1" in params["block0"]["moe"]
+
+    def loss(p):
+        logits = lm.apply({"params": p}, tokens)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tgt = jnp.roll(tokens, -1, axis=1)
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], axis=-1))
+
+    l, g = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(l))
+    gn = np.sqrt(sum(float(np.sum(np.square(x))) for x in jax.tree.leaves(g)))
+    assert np.isfinite(gn) and gn > 0
+    # expert-parallel shardings apply through the whole model tree
+    specs = ep_param_specs(params, E)
+    assert tuple(specs["block0"]["moe"]["w1"]) == (EXPERT_AXIS,)
+    assert tuple(specs["block0"]["attn"]["qkv"]["kernel"]) == ()
+    sh = shard_params_ep(params, expert_mesh(4), E)
+    l_sh = jax.jit(loss)(sh)
+    np.testing.assert_allclose(float(l_sh), float(l), rtol=1e-6)
+
+
+def test_moe_aux_loss_reachable_through_transformer_lm():
+    # the load-balance term is sown into `intermediates`, so a wrapping
+    # model exposes it without any wiring — and including it in the loss
+    # backprops into the gate (the documented recipe, models/moe.py)
+    from federated_pytorch_test_tpu.models import TransformerLM
+
+    lm = TransformerLM(vocab=32, dim=16, num_heads=2, max_len=16,
+                       moe_experts=E)
+    tokens = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    params = lm.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss(p):
+        logits, mut = lm.apply(
+            {"params": p}, tokens, mutable=["intermediates"]
+        )
+        aux_terms = jax.tree.leaves(mut["intermediates"])
+        assert len(aux_terms) == 4  # one per block
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tgt = jnp.roll(tokens, -1, axis=1)
+        ce = -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], axis=-1))
+        return ce + 0.01 * sum(aux_terms)
+
+    l, g = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(l))
+    gate_gn = float(
+        jnp.sum(jnp.abs(g["block0"]["moe"]["gate"]["kernel"]))
+    )
+    assert np.isfinite(gate_gn) and gate_gn > 0
+
+
+def test_ep_guards():
+    layer = _layer()
+    params, _ = _init(layer)
+    from federated_pytorch_test_tpu.parallel import client_mesh
+
+    with pytest.raises(ValueError, match="no 'experts' axis"):
+        shard_params_ep(params, client_mesh(4), E)
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_params_ep(params, expert_mesh(3), E)
