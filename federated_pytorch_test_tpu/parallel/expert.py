@@ -72,6 +72,11 @@ def shard_params_ep(
             f"mesh {tuple(mesh.axis_names)} has no {EXPERT_AXIS!r} axis — "
             "build it with expert_mesh()/client_expert_mesh()"
         )
+    if client_axis and CLIENT_AXIS not in mesh.shape:
+        raise ValueError(
+            f"client_axis=True needs a {CLIENT_AXIS!r} mesh axis — build "
+            "the mesh with client_expert_mesh()"
+        )
     de = mesh.shape[EXPERT_AXIS]
     if n_experts % de != 0:
         raise ValueError(

@@ -121,7 +121,7 @@ def spmd_pipeline(
     state0 = mark_varying(jnp.zeros_like(xs[0]), axis_name)
     outbuf0 = mark_varying(jnp.zeros_like(xs), axis_name)
     (_, outbuf), _ = lax.scan(
-        cycle, (state0, outbuf0), jnp.arange(m + _static_axis_size(axis_name) - 1)
+        cycle, (state0, outbuf0), jnp.arange(m + n_stages - 1)
     )
     # only the last stage holds real outputs; psum broadcasts them (every
     # other stage contributes zeros)
@@ -150,6 +150,11 @@ def pipeline_apply(
     Differentiable end-to-end; the returned `[M, ...]` outputs equal the
     sequential composition of the stages (tested in tests/test_pipeline.py).
     """
+    if STAGE_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh {tuple(mesh.axis_names)} has no {STAGE_AXIS!r} axis — "
+            "build it with stage_mesh()/client_stage_mesh()"
+        )
     s = mesh.shape[STAGE_AXIS]
     lead = jax.tree.leaves(stacked_params)[0].shape[0]
     if lead != s:

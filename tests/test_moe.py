@@ -14,7 +14,8 @@ from federated_pytorch_test_tpu.models.moe import (
     shard_params_ep,
 )
 
-pytestmark = pytest.mark.smoke  # fast CI tier
+# spec/guard tests (no jit) are smoke; the compile-heavy numerics tests
+# ride the unmarked middle tier
 
 DIM, E = 8, 4
 
@@ -73,6 +74,7 @@ def test_moe_aux_loss_is_one_at_uniform_routing():
     assert abs(float(aux) - 1.0) < 1e-6
 
 
+@pytest.mark.smoke
 def test_ep_specs_shard_only_expert_stacks():
     layer = _layer()
     params, _ = _init(layer)
@@ -188,6 +190,7 @@ def test_moe_aux_loss_reachable_through_transformer_lm():
     assert np.isfinite(gate_gn) and gate_gn > 0
 
 
+@pytest.mark.smoke
 def test_ep_guards():
     layer = _layer()
     params, _ = _init(layer)
@@ -197,3 +200,5 @@ def test_ep_guards():
         shard_params_ep(params, client_mesh(4), E)
     with pytest.raises(ValueError, match="not divisible"):
         shard_params_ep(params, expert_mesh(3), E)
+    with pytest.raises(ValueError, match="client_axis=True needs"):
+        shard_params_ep(params, expert_mesh(4), E, client_axis=True)

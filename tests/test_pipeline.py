@@ -24,7 +24,8 @@ from federated_pytorch_test_tpu.parallel.pipeline import (
     stage_mesh,
 )
 
-pytestmark = pytest.mark.smoke  # fast CI tier
+# the stage-count guard (no jit) is smoke; the compile-heavy numerics
+# tests ride the unmarked middle tier
 
 DIM, HEADS, S_STAGES, M_MICRO = 16, 2, 4, 6
 
@@ -85,12 +86,23 @@ def test_pipeline_gradients_match_sequential():
     )
 
 
+@pytest.mark.smoke
 def test_pipeline_stage_count_must_match_mesh():
     blk, stage_params, xs = _stages_and_data()
     mesh = stage_mesh(2)  # 4 stacked stages on a 2-device stages axis
     stacked = stack_stage_params(stage_params)
     with pytest.raises(ValueError, match="one stage per device"):
         pipeline_apply(blk.apply, stacked, xs, mesh)
+
+
+@pytest.mark.smoke
+def test_pipeline_rejects_mesh_without_stages_axis():
+    from federated_pytorch_test_tpu.parallel import client_mesh
+
+    blk, stage_params, xs = _stages_and_data()
+    stacked = stack_stage_params(stage_params)
+    with pytest.raises(ValueError, match="no 'stages' axis"):
+        pipeline_apply(blk.apply, stacked, xs, client_mesh(4))
 
 
 def test_pipeline_composes_with_client_axis():

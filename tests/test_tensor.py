@@ -23,7 +23,8 @@ from federated_pytorch_test_tpu.parallel.tensor import (
     validate_tp_divisibility,
 )
 
-pytestmark = pytest.mark.smoke  # fast CI tier
+# spec/guard tests (no jit of the full model) are smoke; the
+# compile-heavy numerics tests ride the unmarked middle tier
 
 
 def _lm():
@@ -42,6 +43,7 @@ def _loss(model, params, tokens):
     return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
 
 
+@pytest.mark.smoke
 def test_tp_specs_follow_megatron_alternation():
     model = _lm()
     params, _ = _init(model)
@@ -61,6 +63,7 @@ def test_tp_specs_follow_megatron_alternation():
     assert tuple(blk["ln1"]["scale"]) == ()
 
 
+@pytest.mark.smoke
 def test_tp_params_are_distributed():
     model = _lm()
     params, _ = _init(model)
@@ -74,6 +77,7 @@ def test_tp_params_are_distributed():
     assert {s.data.shape for s in ln.addressable_shards} == {(64,)}
 
 
+@pytest.mark.smoke
 def test_tp_divisibility_is_validated():
     model = TransformerLM(vocab=64, dim=64, num_heads=4, max_len=32)
     params, _ = _init(model)
@@ -185,6 +189,7 @@ def test_tp_small_classifier_head_stays_replicated():
     assert {s.data.shape for s in fc1.addressable_shards} == {(64, 256 // 4)}
 
 
+@pytest.mark.smoke
 def test_tp_client_axis_mismatch_fails_loudly():
     # K not divisible by the mesh's clients axis cannot be demoted
     # (replicating K would silently turn client parallelism off) — it must
@@ -195,6 +200,7 @@ def test_tp_client_axis_mismatch_fails_loudly():
         shard_params_tp(stacked, client_model_mesh(2, 4), client_axis=True)
 
 
+@pytest.mark.smoke
 def test_tp_rejects_mesh_that_shards_nothing():
     model = _lm()
     params, _ = _init(model)
@@ -202,6 +208,7 @@ def test_tp_rejects_mesh_that_shards_nothing():
         shard_params_tp(params, model_mesh(7))
 
 
+@pytest.mark.smoke
 def test_tp_rejects_mesh_without_model_axis():
     from federated_pytorch_test_tpu.parallel import client_mesh
 
