@@ -1,8 +1,11 @@
 """Benchmark: flagship 3-client ResNet18 FedAvg hot loop on real hardware.
 
-Prints ONE JSON line:
+The FINAL stdout line is ONE compact JSON headline (the driver parses
+the last line of a bounded stdout tail, so it must stay short):
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
-   "mfu": ..., "achieved_tflops": ..., "roofline": {...}, "sweep": [...]}
+   "mfu": ..., "mxu_pct_peak": ...}
+The full record (roofline, sweep, MXU probe) is written to
+`benchmarks/bench_full.json`.
 
 The hot loop is the jitted sharded epoch function — every client's
 stochastic L-BFGS step (up to 4 inner iterations, Armijo line-search
@@ -283,33 +286,80 @@ def main() -> None:
         b = jnp.ones((n, n), jnp.bfloat16) * jnp.bfloat16(1e-4)
 
         def chain(a, b):
-            # INDEPENDENT matmuls (lhs perturbed per iteration so none is
-            # CSE'd or dead): a dependent chain pipelines poorly and
-            # measures ~28% where this shape reaches ~83% of peak
-            def body(i, acc):
+            # INDEPENDENT matmuls (lhs perturbed per term so none is
+            # CSE'd or dead), UNROLLED (a fori_loop body is counted only
+            # once by cost_analysis — verified — which would undercount
+            # the FLOP check below by x inner), and the FULL product
+            # consumed: round 3 reduced a [:1,:1] slice, which XLA
+            # narrows to a single dot row — the chip did ~1/n of the
+            # assumed FLOPs and pct_peak read a physically impossible
+            # 177%. jnp.sum over all n^2 outputs forces every matmul to
+            # exist whole.
+            acc = jnp.float32(0)
+            for i in range(inner):
                 ai = a * jnp.bfloat16(1.0 + i * 1e-6)
-                return acc + jnp.sum((ai @ b)[:1, :1])
+                acc = acc + jnp.sum((ai @ b).astype(jnp.float32))
+            return acc
 
-            return jax.lax.fori_loop(0, inner, body, jnp.float32(0))
-
-        step = jax.jit(chain)
-        float(step(a, b))  # compile + warmup
+        # the FLOP numerator is cross-checked against XLA's cost model of
+        # the program actually compiled (full unrolled chain, so the
+        # counts are comparable): take the smaller so any further
+        # compiler narrowing can only LOWER the reported utilization
+        compiled_probe = jax.jit(chain).lower(a, b).compile()
+        probe_flops = 2.0 * n * n * n * inner
+        try:
+            ca = compiled_probe.cost_analysis()
+            ca = ca if isinstance(ca, dict) else ca[0]
+            cm = float(ca.get("flops", 0.0))
+            if cm > 0.0:
+                probe_flops = min(probe_flops, cm)
+        except Exception:
+            pass
+        float(compiled_probe(a, b))  # warmup; scalar fetch = true barrier
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
-            float(step(a, b))
+            float(compiled_probe(a, b))
             best = min(best, time.perf_counter() - t0)
-        probe_tflops = 2.0 * n * n * n * inner / best / 1e12
+        probe_tflops = probe_flops / best / 1e12
+        pct = round(100.0 * probe_tflops / peak_tflops, 1) if peak_tflops else None
         out["mxu_probe"] = {
             "shape": f"{n}x{n} bf16 matmul chain x{inner}",
             "achieved_tflops": round(probe_tflops, 1),
-            "pct_peak": (
-                round(100.0 * probe_tflops / peak_tflops, 1)
-                if peak_tflops else None
-            ),
+            "pct_peak": pct,
+            # a >100% reading means the timing barrier or FLOP accounting
+            # failed; say so in the artifact instead of publishing it
+            "valid": bool(pct is None or pct <= 100.0),
         }
 
-    print(json.dumps(out))
+    # The full blob (sweep, roofline, probe) goes to a file; the FINAL
+    # stdout line is a compact headline only. The driver keeps a bounded
+    # tail of stdout and parses its last line — round 3's ~3KB line was
+    # truncated mid-JSON and recorded as parsed:null.
+    full_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "bench_full.json"
+    )
+    try:
+        with open(full_path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"full results -> {full_path}", flush=True)
+    except OSError:
+        print(json.dumps(out), flush=True)  # read-only checkout: keep data
+
+    headline = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "batch": out["batch"],
+        "dtype": out["dtype"],
+        "mfu": out.get("mfu"),
+        "epoch_time_s": out["roofline"]["epoch_time_s"],
+    }
+    if "mxu_probe" in out:
+        headline["mxu_pct_peak"] = out["mxu_probe"]["pct_peak"]
+        headline["mxu_probe_valid"] = out["mxu_probe"]["valid"]
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

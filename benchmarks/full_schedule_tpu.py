@@ -49,6 +49,10 @@ def main() -> None:
     # the linearly-separable easy synthetic (every healthy config hits
     # 1.0 — useful only for throughput, not as an oracle)
     ap.add_argument("--separable", action="store_true")
+    # load a REAL-FORMAT on-disk archive (scripts/make_cifar_archive.py
+    # writes a checksum-verified one in the published binary layout) via
+    # the real loader path — native bin decoding, no synthetic fallback
+    ap.add_argument("--real-archive", metavar="ROOT", default=None)
     args = ap.parse_args()
 
     import jax
@@ -61,10 +65,14 @@ def main() -> None:
     over = {"nloop": args.nloop} if args.nloop is not None else {}
     if args.stream:
         over.update(hbm_data_budget_mb=0, stream_chunk_steps=8)
+    if args.real_archive:
+        over.update(data_root=args.real_archive, synthetic_ok=False)
     cfg = get_preset(args.preset, **over)
     source = None
     hardness = None
-    if not args.separable:
+    if args.real_archive:
+        pass  # Trainer loads from disk through load_cifar (bin decoder)
+    elif not args.separable:
         # the parity oracle's HARDNESS knobs (convergence_parity.py):
         # sub-saturation accuracy makes the curve discriminating
         hardness = dict(noise=110.0, overlap=0.35, label_noise=0.25)
@@ -92,7 +100,11 @@ def main() -> None:
         "backend": "tpu",
         "device": str(jax.devices()[0]),
         "dataset": (
-            "synthetic 50k/10k, separable (throughput only)"
+            f"REAL-FORMAT binary archive at {args.real_archive} "
+            "(published CIFAR bin layout, native decoder, no synthetic "
+            "fallback; generator: scripts/make_cifar_archive.py)"
+            if args.real_archive
+            else "synthetic 50k/10k, separable (throughput only)"
             if args.separable
             else "synthetic 50k/10k DISCRIMINATING "
             f"(overlap {hardness['overlap']}, label noise "
@@ -127,9 +139,10 @@ def main() -> None:
         out["final_dual_residual"] = float(rec.latest("dual_residual"))
         out["final_mean_rho"] = float(rec.latest("mean_rho"))
 
+    suffix = "_realformat" if args.real_archive else ""
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        f"full_{args.preset}_tpu.json",
+        f"full_{args.preset}{suffix}_tpu.json",
     )
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

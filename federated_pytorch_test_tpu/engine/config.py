@@ -28,6 +28,14 @@ class ExperimentConfig:
 
     name: str = "custom"
     model: str = "net"  # net | net1 | net2 | resnet18 | vit (models.MODELS)
+    # extra constructor kwargs for the model class (validated against its
+    # dataclass fields by the Trainer) — e.g. {"moe_experts": 8} turns the
+    # ViT into a switch-MoE ViT (models/moe.py)
+    model_kwargs: dict = dataclasses.field(default_factory=dict)
+    # weight of the switch load-balance aux loss when the model sows
+    # `moe_aux` (models/moe.py:145); ignored for non-MoE models. Without
+    # this term routing can collapse onto few experts.
+    moe_aux_coef: float = 0.01
     # 'bfloat16' runs convs/matmuls AND norm elementwise math in bf16
     # (params, the loss, and ALL L-BFGS math stay f32 — mixed precision,
     # not low precision). 'float32' matches the reference bit-for-bit in

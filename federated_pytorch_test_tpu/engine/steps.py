@@ -102,19 +102,31 @@ class GroupContext(NamedTuple):
     lambda2: float = 1e-4
     # rematerialize the forward in the backward pass (jax.checkpoint)
     remat: bool = False
+    # >0: collect the model's sown `moe_aux` load-balance terms
+    # (models/moe.py:145) and add coef * sum to the training loss — without
+    # it a MoE model trained through the engine can collapse its routing
+    moe_aux_coef: float = 0.0
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
     """One client's CE loss (+ updated batch stats) at full flat params."""
     params = ctx.unravel(flat)
+    collections = []
     if ctx.has_stats:
-        variables = {"params": params, "batch_stats": stats}
+        collections.append("batch_stats")
+    if ctx.moe_aux_coef:
+        collections.append("intermediates")
+    if collections:
+        variables = {"params": params}
+        if ctx.has_stats:
+            variables["batch_stats"] = stats
         logits, updated = ctx.model.apply(
-            variables, images, train=True, mutable=["batch_stats"]
+            variables, images, train=True, mutable=collections
         )
-        new_stats = updated["batch_stats"]
+        new_stats = updated["batch_stats"] if ctx.has_stats else stats
     else:
         logits = ctx.model.apply({"params": params}, images, train=True)
+        updated = {}
         new_stats = stats
     # loss always in f32: under compute_dtype=bfloat16 the logits arrive
     # bf16, and the softmax/CE must not round (L-BFGS line-search decisions
@@ -122,6 +134,20 @@ def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labe
     loss = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), labels
     ).mean()
+    if ctx.moe_aux_coef:
+        # every MoE layer sows its switch load-balance term under moe_aux
+        aux = [
+            jnp.asarray(leaf, jnp.float32)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                updated.get("intermediates", {})
+            )[0]
+            if any(
+                getattr(k, "key", getattr(k, "name", None)) == "moe_aux"
+                for k in path
+            )
+        ]
+        if aux:
+            loss = loss + ctx.moe_aux_coef * sum(jnp.sum(a) for a in aux)
     return loss, new_stats
 
 

@@ -100,6 +100,17 @@ class Trainer:
             kw["num_classes"] = self.fed.num_classes
         if "dtype" in fields:
             kw["dtype"] = jnp.dtype(cfg.compute_dtype)
+        # flax adds 'parent'/'name' to every Module's dataclass fields;
+        # they are wiring, not model knobs
+        settable = set(fields) - {"parent", "name"}
+        bad = sorted(set(cfg.model_kwargs) - settable)
+        if bad:
+            raise ValueError(
+                f"model_kwargs {bad} are not fields of {cfg.model!r} "
+                f"({model_cls.__name__}); valid extras: "
+                f"{sorted(settable - set(kw))}"
+            )
+        kw.update(cfg.model_kwargs)
         self.model = model_cls(**kw)
 
         variables = self._init_variables()
@@ -314,6 +325,13 @@ class Trainer:
             lambda1=cfg.lambda1,
             lambda2=cfg.lambda2,
             remat=cfg.remat,
+            # the switch load-balance term is only sown when the model has
+            # experts; a zero coef keeps non-MoE programs free of the
+            # intermediates collection entirely
+            moe_aux_coef=(
+                cfg.moe_aux_coef
+                if getattr(self.model, "moe_experts", 0) else 0.0
+            ),
         )
 
     def _fns(self, gid: int):

@@ -304,6 +304,7 @@ class ViT(PartitionedModel):
     patch: int = 4
     attn_impl: str = "dense"
     attn_precision: Any = None
+    moe_experts: int = 0  # >0: switch-MoE MLPs (models/moe.py)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -329,6 +330,7 @@ class ViT(PartitionedModel):
                 self.num_heads,
                 attn_impl=self.attn_impl,
                 attn_precision=self.attn_precision,
+                moe_experts=self.moe_experts,
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x)

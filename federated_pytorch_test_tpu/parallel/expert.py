@@ -42,15 +42,40 @@ def ep_param_specs(tree: PyTree, n_experts: int, client_axis: bool = False) -> P
 
     A leaf is an expert stack iff its leading axis (after any client axis)
     equals `n_experts` AND its leaf name is one of MoEMLP's expert params
-    (w1/b1/w2/b2). With `client_axis=True` (stacked `[K, ...]` trees)
-    every spec gets the `clients` axis prepended.
+    (w1/b1/w2/b2) AND it lives in a MoE scope: a path component containing
+    "moe" (TransformerLM names the layer `moe`) or a sibling `gate`
+    projection (MoEMLP's own structure, which also covers a bare MoEMLP
+    tree with no enclosing scope). The scope requirement keeps an
+    unrelated param that happens to be named w1 with a matching leading
+    axis from being silently sharded on the experts axis. With
+    `client_axis=True` (stacked `[K, ...]` trees) every spec gets the
+    `clients` axis prepended.
     """
 
+    def _names(path):
+        return tuple(getattr(k, "key", getattr(k, "name", None)) for k in path)
+
+    # nodes that contain a `gate` submodule: their direct children are
+    # MoEMLP's params (leaf paths look like <node>/gate/kernel)
+    leaf_paths = [
+        _names(p) for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+    gate_scopes = {p[:-2] for p in leaf_paths if len(p) >= 2 and p[-2] == "gate"}
+
     def spec(path, leaf):
-        names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        names = _names(path)
+        in_moe = names[:-1] in gate_scopes or any(
+            isinstance(n, str) and "moe" in n.lower() for n in names[:-1]
+        )
         shape = leaf.shape[1:] if client_axis else leaf.shape
         s = P()
-        if names and names[-1] in _EXPERT_LEAVES and shape and shape[0] == n_experts:
+        if (
+            in_moe
+            and names
+            and names[-1] in _EXPERT_LEAVES
+            and shape
+            and shape[0] == n_experts
+        ):
             s = P(EXPERT_AXIS)
         if client_axis:
             s = P(CLIENT_AXIS, *tuple(s))

@@ -156,7 +156,15 @@ def pipeline_apply(
             "build it with stage_mesh()/client_stage_mesh()"
         )
     s = mesh.shape[STAGE_AXIS]
-    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    leads = {getattr(leaf, "shape", ())[0] if getattr(leaf, "ndim", 0) else None
+             for leaf in jax.tree.leaves(stacked_params)}
+    if len(leads) != 1 or None in leads:
+        raise ValueError(
+            f"stacked params have inconsistent leading dims {sorted(leads, key=str)} "
+            "— every leaf must be stacked [S, ...] with the same stage count "
+            "(build the tree with stack_stage_params())"
+        )
+    (lead,) = leads
     if lead != s:
         raise ValueError(
             f"stacked params carry {lead} stages but the mesh's "

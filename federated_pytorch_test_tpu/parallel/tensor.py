@@ -54,6 +54,11 @@ PyTree = Any
 _COLUMN_PARALLEL = ("qkv", "fc1", "head")
 _ROW_PARALLEL = ("proj", "fc2")
 
+# column/row partners: sharding only one side of a pair is correct (GSPMD
+# inserts the resharding) but silently doubles the collective traffic, so
+# divisibility demotion applies to the whole pair (see tp_param_specs)
+_PAIR = {"qkv": "proj", "proj": "qkv", "fc1": "fc2", "fc2": "fc1"}
+
 
 def model_mesh(d_model: int, devices: Sequence[jax.Device] | None = None) -> Mesh:
     """A 1-D mesh over `d_model` devices with the `model` axis (pure TP)."""
@@ -74,6 +79,14 @@ def client_model_mesh(
     return mesh_2d((CLIENT_AXIS, MODEL_AXIS), d_clients, d_model, devices)
 
 
+def _layer_of(names) -> tuple:
+    """(index, name) of the first Megatron-role component in a path."""
+    for i, n in enumerate(names):
+        if n in _COLUMN_PARALLEL + _ROW_PARALLEL:
+            return i, n
+    return -1, None
+
+
 def _leaf_spec(path, ndim: int) -> P:
     """Sharding spec for one param leaf, from its tree path and rank.
 
@@ -81,7 +94,7 @@ def _leaf_spec(path, ndim: int) -> P:
     caller strips it for client-stacked trees.
     """
     names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
-    layer = next((n for n in names if n in _COLUMN_PARALLEL + _ROW_PARALLEL), None)
+    _, layer = _layer_of(names)
     leaf_name = names[-1] if names else None
     if layer is None:
         return P()
@@ -113,7 +126,11 @@ def tp_param_specs(
     With a `mesh`, any leaf whose sharded axis does not divide evenly by
     the mesh axis is demoted to replicated — the fallback that keeps small
     classifier heads (e.g. ViT's 10-way `head`) whole while the rest of
-    the network shards. Without a mesh the specs are the pure rule table
+    the network shards. Demotion applies to a Megatron column/row PAIR as
+    a unit: if `qkv` cannot split, its `proj` partner is demoted too (and
+    vice versa; same for fc1/fc2), with a warning — a half-sharded pair
+    would still be correct (GSPMD reshards) but silently pay extra
+    collective traffic. Without a mesh the specs are the pure rule table
     (divisibility is then the caller's problem; see
     `validate_tp_divisibility`).
     """
@@ -129,9 +146,53 @@ def tp_param_specs(
                     f"build it with {builder}"
                 )
 
+    # pass 1: layer scopes (path prefix up to the layer name) whose own
+    # leaves cannot divide — the pair demotion set
+    demoted: set[tuple] = set()
+    if mesh is not None:
+
+        def scan(path, leaf):
+            names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+            idx, layer = _layer_of(names)
+            if layer is None:
+                return
+            s = _leaf_spec(path, leaf.ndim - 1 if client_axis else leaf.ndim)
+            if tuple(s) and not _divides(
+                leaf.shape[1:] if client_axis else leaf.shape, s, mesh
+            ):
+                demoted.add(tuple(names[: idx + 1]))
+
+        jax.tree_util.tree_map_with_path(scan, tree)
+        for scope in sorted(demoted):
+            partner = _PAIR.get(scope[-1])
+            if partner and scope[:-1] + (partner,) not in demoted:
+                import warnings
+
+                warnings.warn(
+                    f"TP: {'/'.join(map(str, scope))} cannot divide by "
+                    f"d_model={mesh.shape[MODEL_AXIS]}; demoting its "
+                    f"Megatron partner {partner!r} to replicated as well "
+                    "so the pair stays consistent",
+                    stacklevel=3,
+                )
+
+    def _pair_demoted(names) -> bool:
+        idx, layer = _layer_of(names)
+        if layer is None:
+            return False
+        scope = tuple(names[: idx + 1])
+        partner = _PAIR.get(layer)
+        return scope in demoted or (
+            partner is not None and scope[:-1] + (partner,) in demoted
+        )
+
     def spec(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
         s = _leaf_spec(path, leaf.ndim - 1 if client_axis else leaf.ndim)
-        if mesh is not None and not _divides(leaf.shape[1:] if client_axis else leaf.shape, s, mesh):
+        if mesh is not None and (
+            _pair_demoted(names)
+            or not _divides(leaf.shape[1:] if client_axis else leaf.shape, s, mesh)
+        ):
             s = P()
         if client_axis:
             if mesh is not None and leaf.shape[0] % mesh.shape[CLIENT_AXIS] != 0:

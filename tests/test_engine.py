@@ -653,3 +653,42 @@ def test_max_groups_limits_partition_order():
     assert len(rec.series["dual_residual"]) == 2  # one round per group
     with pytest.raises(ValueError, match="max_groups"):
         tiny("fedavg", max_groups=0)
+
+
+def test_moe_aux_loss_reaches_engine_loss():
+    # ADVICE r3: a MoE model trained through the Trainer must optimize the
+    # switch load-balance term, not silently drop it. The ViT-MoE's sown
+    # `moe_aux` (models/moe.py:145) flows into the engine loss scaled by
+    # cfg.moe_aux_coef; zeroing the coef removes exactly that term.
+    import jax.numpy as jnp
+
+    from federated_pytorch_test_tpu.engine.steps import _data_loss
+
+    cfg = tiny("fedavg", model="vit", model_kwargs={"moe_experts": 2})
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    assert tr.model.moe_experts == 2
+    ctx = tr._ctx(tr.group_order[0])
+    assert ctx.moe_aux_coef == cfg.moe_aux_coef > 0
+
+    flat0 = jnp.asarray(np.asarray(tr.flat)[0])
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.normal(size=(4, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=(4,)), jnp.int32)
+    with_aux, _ = _data_loss(ctx, flat0, {}, imgs, labels)
+    without, _ = _data_loss(
+        ctx._replace(moe_aux_coef=0.0), flat0, {}, imgs, labels
+    )
+    # the switch aux term E * sum(frac * prob) is >= 1 per MoE layer
+    # (Cauchy-Schwarz, equality at uniform routing); 4 blocks at coef c
+    # must raise the loss by >= ~4c
+    gap = float(with_aux) - float(without)
+    assert gap > 0.9 * 4 * cfg.moe_aux_coef, gap
+
+
+def test_model_kwargs_are_validated():
+    with pytest.raises(ValueError, match="model_kwargs"):
+        Trainer(
+            tiny("fedavg", model="net", model_kwargs={"moe_experts": 2}),
+            verbose=False,
+            source=SRC,
+        )

@@ -202,3 +202,27 @@ def test_ep_guards():
         shard_params_ep(params, expert_mesh(3), E)
     with pytest.raises(ValueError, match="client_axis=True needs"):
         shard_params_ep(params, expert_mesh(4), E, client_axis=True)
+
+
+@pytest.mark.smoke
+def test_ep_specs_require_a_moe_scope():
+    # an unrelated param named w1 with a matching leading axis must NOT be
+    # sharded on the experts axis (ADVICE r3): expert leaves are only
+    # recognized inside a scope named like 'moe' or alongside a `gate`
+    # projection (MoEMLP's own structure)
+    from jax.sharding import PartitionSpec as P
+
+    lookalike = {"custom": {"w1": np.zeros((E, 3), np.float32)}}
+    specs = ep_param_specs(lookalike, E)
+    assert specs["custom"]["w1"] == P()
+
+    # a bare MoEMLP param tree (gate sibling, no enclosing scope) shards
+    layer = _layer()
+    params, _ = _init(layer)
+    bare = ep_param_specs(params, E)
+    assert bare["w1"] == P(EXPERT_AXIS)
+    assert bare["gate"]["kernel"] == P()
+
+    # and a 'moe'-named scope shards even without the gate visible
+    scoped = {"moe": {"w1": np.zeros((E, 3), np.float32)}}
+    assert ep_param_specs(scoped, E)["moe"]["w1"] == P(EXPERT_AXIS)

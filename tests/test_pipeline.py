@@ -146,3 +146,31 @@ def test_pipeline_composes_with_client_axis():
         np.testing.assert_allclose(
             np.asarray(out[c]), np.asarray(ref_c), atol=2e-5, rtol=1e-5
         )
+
+
+@pytest.mark.smoke
+def test_pipeline_rejects_heterogeneous_stage_stacks():
+    # a malformed stacked tree (leaves with different leading dims) must
+    # hit the friendly guard, not an opaque sharding/shape error later
+    mesh = stage_mesh(S_STAGES)
+    bad = {
+        "a": np.zeros((S_STAGES, 3), np.float32),
+        "b": np.zeros((S_STAGES - 1, 3), np.float32),
+    }
+    with pytest.raises(ValueError, match="inconsistent leading dims"):
+        pipeline_apply(
+            lambda p, x: x, bad, np.zeros((M_MICRO, 1), np.float32), mesh
+        )
+
+
+@pytest.mark.smoke
+def test_pipeline_rejects_scalar_leaves_in_stack():
+    mesh = stage_mesh(S_STAGES)
+    bad = {
+        "a": np.zeros((S_STAGES, 3), np.float32),
+        "scale": 1.0,  # plain Python scalar: cannot carry a stage axis
+    }
+    with pytest.raises(ValueError, match="inconsistent leading dims"):
+        pipeline_apply(
+            lambda p, x: x, bad, np.zeros((M_MICRO, 1), np.float32), mesh
+        )

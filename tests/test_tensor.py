@@ -241,3 +241,36 @@ def test_tp_composes_with_client_axis():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
     )
+
+
+@pytest.mark.smoke
+def test_tp_pair_demotion_keeps_megatron_pairs_consistent():
+    # qkv's output axis (24) divides d_model=3 but proj's input axis (8)
+    # does not: without pair demotion qkv would shard alone and GSPMD
+    # would silently insert resharding between the pair (ADVICE r3) —
+    # both sides must come out replicated, with a warning
+    from jax.sharding import PartitionSpec as P
+
+    tree = {
+        "attn": {
+            "qkv": {
+                "kernel": np.zeros((8, 24), np.float32),
+                "bias": np.zeros((24,), np.float32),
+            },
+            "proj": {
+                "kernel": np.zeros((8, 8), np.float32),
+                "bias": np.zeros((8,), np.float32),
+            },
+        }
+    }
+    mesh = model_mesh(3)
+    with pytest.warns(UserWarning, match="demoting its Megatron partner"):
+        specs = tp_param_specs(tree, mesh=mesh)
+    assert all(s == P() for s in jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)))
+
+    # sanity: with a divisible mesh the same tree shards both sides
+    mesh2 = model_mesh(2)
+    specs2 = tp_param_specs(tree, mesh=mesh2)
+    assert specs2["attn"]["qkv"]["kernel"] == P(None, MODEL_AXIS)
+    assert specs2["attn"]["proj"]["kernel"] == P(MODEL_AXIS, None)
