@@ -274,36 +274,44 @@ def main() -> None:
     # ---- MXU saturation probe ----
     # the sweep shows the FLAGSHIP workload's utilization ceiling (the
     # inner solver's sequential chain binds before either roofline
-    # wall). This probe shows the CHIP is not the limit: large
-    # independent bf16 matmuls, the shape XLA tiles perfectly onto the
-    # MXU. Its %-of-peak is the denominator against which every workload
-    # row should be read.
+    # wall). This probe shows the CHIP is not the limit: a DEPENDENT
+    # chain of large bf16 matmuls, the shape XLA tiles perfectly onto
+    # the MXU (dependence is what keeps the simplifier from collapsing
+    # the chain — see the in-function comment). Its %-of-peak is the
+    # denominator against which every workload row should be read.
     if run_sweep:
         import jax.numpy as jnp
 
-        n, inner = 16384, 4
+        n, inner = 16384, 16
         a = jnp.ones((n, n), jnp.bfloat16)
         b = jnp.ones((n, n), jnp.bfloat16) * jnp.bfloat16(1e-4)
 
         def chain(a, b):
-            # INDEPENDENT matmuls (lhs perturbed per term so none is
-            # CSE'd or dead), UNROLLED (a fori_loop body is counted only
-            # once by cost_analysis — verified — which would undercount
-            # the FLOP check below by x inner), and the FULL product
-            # consumed: round 3 reduced a [:1,:1] slice, which XLA
-            # narrows to a single dot row — the chip did ~1/n of the
-            # assumed FLOPs and pct_peak read a physically impossible
-            # 177%. jnp.sum over all n^2 outputs forces every matmul to
-            # exist whole.
-            acc = jnp.float32(0)
-            for i in range(inner):
-                ai = a * jnp.bfloat16(1.0 + i * 1e-6)
-                acc = acc + jnp.sum((ai @ b).astype(jnp.float32))
-            return acc
+            # a DEPENDENT chain: each LHS is the previous product, so no
+            # matmul can be CSE'd, hoisted, or algebraically collapsed.
+            # Every cheaper formulation tried was silently destroyed by
+            # the simplifier (all verified against cost_analysis):
+            #   * `sum((s_i*a) @ b)` — scalar factors hoist out of the
+            #     dot and the n identical matmuls CSE to ONE;
+            #   * `sum(a @ b)` — rewritten as dot(colsum(a), rowsum(b)),
+            #     O(n^2), no matmul at all (round 3's 177%-of-peak bug
+            #     was the [:1,:1]-slice flavor of the same narrowing);
+            #   * a fori_loop body is counted ONCE by cost_analysis,
+            #     breaking the FLOP cross-check below.
+            # The final reduction is sum of SQUARES — a plain sum would
+            # let the last matmul collapse through the same rewrite.
+            # inner=16 amortizes the tunneled runtime's ~0.14 s flat
+            # dispatch+fetch latency (inner=4 reads ~62% for the same
+            # chip state; 16 chained 16k matmuls measure ~89%).
+            c = a
+            for _ in range(inner):
+                c = (c @ b) * jnp.bfloat16(1e-1)  # bound magnitudes
+            cf = c.astype(jnp.float32)
+            return jnp.sum(cf * cf)
 
-        # the FLOP numerator is cross-checked against XLA's cost model of
-        # the program actually compiled (full unrolled chain, so the
-        # counts are comparable): take the smaller so any further
+        # FLOP numerator cross-checked against XLA's cost model of the
+        # program actually compiled (verified equal to the analytic
+        # 2n^3*inner for this chain): take the smaller so any future
         # compiler narrowing can only LOWER the reported utilization
         compiled_probe = jax.jit(chain).lower(a, b).compile()
         probe_flops = 2.0 * n * n * n * inner

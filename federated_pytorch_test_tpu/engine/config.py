@@ -83,6 +83,20 @@ class ExperimentConfig:
 
     biased_input: bool = True  # per-client normalization (reference :31-34)
 
+    # per-batch diagnostic forward at the ACCEPTED params (the reference
+    # prints this loss every minibatch, src/federated_trio.py:341-352).
+    # Measured (benchmarks/epoch_attribution.json): one extra model
+    # forward of the epoch step's ~9 model passes. False skips it — the
+    # parameter trajectory is bit-identical (tested), but the recorded
+    # per-batch loss becomes the optimizer's entry OBJECTIVE (data loss
+    # PLUS any elastic-net/ADMM penalty, one step earlier), so the
+    # series is NOT comparable to diag_forward=True telemetry, and NaN
+    # fault detection trails by one batch. A pure-throughput knob for
+    # BN-less models; models WITH batch stats always run the forward (it
+    # is the only place running BN statistics refresh — enforced in the
+    # step itself).
+    diag_forward: bool = True
+
     # inner optimizer (reference src/federated_trio.py:273-275)
     lbfgs_history: int = 10
     lbfgs_max_iter: int = 4

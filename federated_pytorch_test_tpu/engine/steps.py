@@ -106,6 +106,10 @@ class GroupContext(NamedTuple):
     # (models/moe.py:145) and add coef * sum to the training loss — without
     # it a MoE model trained through the engine can collapse its routing
     moe_aux_coef: float = 0.0
+    # run the per-batch diagnostic forward at accepted params (reference
+    # src/federated_trio.py:341-352). Must stay True for models with
+    # batch stats — it is where running BN statistics refresh.
+    diag_forward: bool = True
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
@@ -213,9 +217,23 @@ def _client_train_step(ctx: GroupContext):
         x0 = ctx.partition.extract(flat, ctx.gid)
         x1, lstate, aux = lbfgs_step(loss_fn, x0, lstate, ctx.lbfgs)
         flat = ctx.partition.insert(flat, ctx.gid, x1)
-        # diagnostic forward at the accepted params: per-batch loss print
-        # (reference src/federated_trio.py:341-352) + batch-stats refresh
-        diag_loss, stats = _data_loss(ctx, flat, stats, images, labels)
+        # the invariant lives with the mechanism, not only in Trainer._ctx:
+        # the diagnostic forward is the ONLY place running BN statistics
+        # refresh, so models with batch stats always run it even if a
+        # hand-built GroupContext says otherwise
+        if ctx.diag_forward or ctx.has_stats:
+            # diagnostic forward at the accepted params: per-batch loss
+            # print (reference src/federated_trio.py:341-352) +
+            # batch-stats refresh
+            diag_loss, stats = _data_loss(ctx, flat, stats, images, labels)
+        else:
+            # throughput mode (BN-less models only): one fewer model pass
+            # per batch, identical parameter trajectory. Reported loss is
+            # the optimizer's entry OBJECTIVE — data loss PLUS any
+            # elastic-net/ADMM penalty terms, one step earlier — so the
+            # telemetry is not comparable to diag_forward=True series
+            # (and NaN detection trails by one batch).
+            diag_loss = aux.loss
         return flat, lstate, stats, diag_loss
 
     return step

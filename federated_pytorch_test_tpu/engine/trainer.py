@@ -332,6 +332,9 @@ class Trainer:
                 cfg.moe_aux_coef
                 if getattr(self.model, "moe_experts", 0) else 0.0
             ),
+            # the diagnostic forward is the only place running BN stats
+            # refresh: models with batch stats always run it
+            diag_forward=cfg.diag_forward or self.has_stats,
         )
 
     def _fns(self, gid: int):

@@ -692,3 +692,24 @@ def test_model_kwargs_are_validated():
             verbose=False,
             source=SRC,
         )
+
+
+def test_diag_forward_off_keeps_trajectory_identical():
+    # skipping the per-batch diagnostic forward (a pure-throughput knob,
+    # benchmarks/epoch_attribution.py) must not change the parameter
+    # trajectory — only the reported per-batch loss (entry vs accepted)
+    runs = {}
+    for diag in (True, False):
+        cfg = tiny("fedavg", nadmm=2, diag_forward=diag)
+        tr = Trainer(cfg, verbose=False, source=SRC)
+        tr.group_order = tr.group_order[:1]
+        tr.run()
+        runs[diag] = np.asarray(tr.flat)
+    assert np.array_equal(runs[True], runs[False])
+
+
+def test_diag_forward_forced_on_for_batch_stats_models():
+    cfg = tiny("fedavg_resnet", batch=8, diag_forward=False,
+               synthetic_n_train=48, synthetic_n_test=24)
+    tr = Trainer(cfg, verbose=False, source=None)
+    assert tr._ctx(tr.group_order[0]).diag_forward is True
