@@ -6,11 +6,13 @@ from federated_pytorch_test_tpu.utils.checkpoint import (
     save_checkpoint,
 )
 from federated_pytorch_test_tpu.utils.hostcpu import (
+    compile_cache_dir,
     force_host_cpu,
     set_host_device_count,
 )
 
 __all__ = [
+    "compile_cache_dir",
     "MetricsRecorder",
     "load_checkpoint",
     "save_checkpoint",

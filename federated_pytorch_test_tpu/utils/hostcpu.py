@@ -59,3 +59,17 @@ def force_host_cpu(min_devices: int | None = None):
             f"initialized before force_host_cpu could raise the count"
         )
     return jax
+
+
+def compile_cache_dir() -> str:
+    """The repo-level persistent XLA compile-cache directory.
+
+    One definition for every consumer — the test conftest, the multichip
+    dryrun, and the fresh-interpreter subprocesses tests spawn (CLI,
+    examples, multiprocess workers) all point jax at this path (config
+    key `jax_compilation_cache_dir` / env `JAX_COMPILATION_CACHE_DIR`);
+    a second copy of the path would silently drift and cost every
+    compile again.
+    """
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), ".cache", "xla")

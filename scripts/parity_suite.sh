@@ -33,8 +33,28 @@ for name, r in sorted(d.items()):
     if not isinstance(r, dict) or "verdict" not in r:
         continue
     v = r["verdict"]
-    fails = [k for k, val in v.items() if isinstance(val, bool) and not val]
-    print(f"{name:16s} {'PASS' if not fails else 'FAIL ' + str(fails)}")
+    # PRIMARY oracle (one-sided, parity-or-better): both sides well
+    # above chance AND the framework not trailing by more than the band
+    fails = [
+        k for k in ("both_above_2x_chance", "framework_ge_reference_minus_band")
+        if not v.get(k, False)
+    ]
+    # trajectory-parity bands (residuals, rho, symmetric accuracy) are
+    # REQUIRED only when the two sides converge to similar accuracy —
+    # when the framework beats the reference beyond the band, the
+    # trajectories legitimately diverge and the bands are informational
+    similar = v.get("final_acc_diff", 1.0) <= v.get("acc_band", 0.05)
+    if similar:
+        fails += [
+            k for k, val in v.items()
+            if isinstance(val, bool) and not val
+            and k not in ("framework_beats_reference",
+                          "both_above_2x_chance",  # primary, checked above
+                          "framework_ge_reference_minus_band")
+        ]
+    beats = " (framework beats reference)" if v.get(
+        "framework_beats_reference") and not similar else ""
+    print(f"{name:16s} {'PASS' + beats if not fails else 'FAIL ' + str(fails)}")
     bad += [(name, f) for f in fails]
 sys.exit(1 if bad else 0)
 PY

@@ -21,15 +21,14 @@ import os
 # force_host_cpu — so it must be set before that call, not after.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
-from federated_pytorch_test_tpu.utils import force_host_cpu
+from federated_pytorch_test_tpu.utils import compile_cache_dir, force_host_cpu
 
 jax = force_host_cpu(min_devices=8)
 jax.config.update("jax_enable_x64", False)
 
 # persistent compilation cache: repeat CI runs skip every XLA backend
 # compile that took >1 s
-_cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                      ".cache", "xla")
+_cache = compile_cache_dir()
 os.makedirs(_cache, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

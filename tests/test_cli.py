@@ -9,11 +9,18 @@ import sys
 
 pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
 
+from federated_pytorch_test_tpu.utils import compile_cache_dir
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = dict(
     os.environ,
     JAX_PLATFORMS="cpu",
     XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    # the CLI subprocess is a fresh interpreter with no conftest: point
+    # it at the same persistent compile cache so repeat CI runs skip the
+    # XLA compiles (the CLI honors the standard jax env var)
+    JAX_COMPILATION_CACHE_DIR=compile_cache_dir(),
+    TF_CPP_MIN_LOG_LEVEL="3",
 )
 
 
@@ -54,6 +61,10 @@ def test_tiny_training_run_with_metrics_out(tmp_path):
         "--n-clients", "4",
         "--synthetic-n-train", "480",
         "--synthetic-n-test", "64",
+        # two of net's five groups: the CLI surface under test (arg
+        # parsing, training dispatch, metrics writing) is identical per
+        # group, and each extra group is another program to trace
+        "--max-groups", "2",
         "--no-check-results",
         "--quiet",
         "--metrics-out", str(out),

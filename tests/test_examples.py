@@ -28,6 +28,10 @@ def test_federated_lm_example_learns():
         NLOOP="1",
         K="4",
         SEQ="32",
+        # fresh interpreter, no conftest: reuse the persistent compile
+        # cache so repeat CI runs skip the example's XLA compiles
+        JAX_COMPILATION_CACHE_DIR=compile_cache_dir(),
+        TF_CPP_MIN_LOG_LEVEL="3",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "federated_lm.py")],

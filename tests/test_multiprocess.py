@@ -41,6 +41,11 @@ def _run_workers(nproc: int, timeout: float = 480.0):
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    from federated_pytorch_test_tpu.utils import compile_cache_dir
+
+    # fresh interpreters, no conftest: share the persistent compile cache
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", compile_cache_dir())
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(i), str(nproc), str(port)],
