@@ -463,6 +463,11 @@ def compare(fw, ref, strategy, acc_band=0.05):
         "acc_mean_within_0.06": float(np.mean(diffs)) <= 0.06,
         "dual_log10_median": _log_ratio_band(fw["dual"], ref["dual"]),
     }
+    # the gate's single source of truth: the PRIMARY oracle as one bool,
+    # so consumers never have to mirror this function's key set
+    out["primary_pass"] = bool(
+        out["both_above_2x_chance"] and out["framework_ge_reference_minus_band"]
+    )
     if out["dual_log10_median"] is not None:
         out["dual_within_half_order"] = out["dual_log10_median"] <= 0.5
     if strategy == "admm":

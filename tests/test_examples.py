@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+from federated_pytorch_test_tpu.utils import compile_cache_dir
+
 pytestmark = pytest.mark.slow  # heavy tier (jit-compile dominated)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,71 @@
+"""Unit tests for the convergence-parity comparator's verdict logic.
+
+The recorded artifact (benchmarks/convergence_parity.json) is produced
+by `compare()`; its one-sided primary oracle — parity or BETTER — must
+not regress: a framework that beats the reference beyond the band is a
+pass, a framework that trails beyond the band is a fail, and the
+symmetric trajectory bands stay informational either way.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_compare():
+    mod = sys.modules.get("convergence_parity")
+    if mod is None:  # load the module exactly once per session
+        spec = importlib.util.spec_from_file_location(
+            "convergence_parity",
+            os.path.join(REPO, "benchmarks", "convergence_parity.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["convergence_parity"] = mod
+    return mod.compare
+
+
+def _run(final_fw, final_ref, strategy="fedavg"):
+    compare = _load_compare()
+    fw = {"acc": [[0.1], [final_fw]], "dual": [1e-3], "primal": [], "mean_rho": []}
+    ref = {"acc": [[0.1], [final_ref]], "dual": [1e-3], "primal": [], "mean_rho": []}
+    return compare(fw, ref, strategy)
+
+
+def test_framework_winning_beyond_band_passes_primary_oracle():
+    v = _run(0.50, 0.30)
+    assert v["framework_ge_reference_minus_band"]
+    assert v["framework_beats_reference"]
+    assert v["both_above_2x_chance"]
+    assert v["primary_pass"]
+    # the symmetric band legitimately fails when one side wins big —
+    # recorded, but not the primary criterion
+    assert not v["acc_final_within_band"]
+
+
+def test_framework_trailing_beyond_band_fails_primary_oracle():
+    v = _run(0.30, 0.50)
+    assert not v["framework_ge_reference_minus_band"]
+    assert not v["framework_beats_reference"]
+    assert not v["primary_pass"]
+
+
+def test_near_chance_results_fail_even_when_matching():
+    # 0.12 vs 0.12: within band but meaningless — both near chance (0.1)
+    v = _run(0.12, 0.12)
+    assert v["acc_final_within_band"]
+    assert not v["both_above_2x_chance"]
+    assert not v["primary_pass"]
+
+
+def test_within_band_parity_passes_all_primary_criteria():
+    v = _run(0.55, 0.58)
+    assert v["framework_ge_reference_minus_band"]
+    assert v["both_above_2x_chance"]
+    assert v["acc_final_within_band"]
