@@ -74,12 +74,37 @@ class _ResNet18(nn.Module):
         return self.linear(out)
 
 
+class _Net(nn.Module):
+    """The reference's 5-layer simple CNN shape-for-shape
+    (reference src/simple_models.py:9-39), ELU, NCHW."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 6, 5)
+        self.conv2 = nn.Conv2d(6, 16, 5)
+        self.fc1 = nn.Linear(400, 120)
+        self.fc2 = nn.Linear(120, 84)
+        self.fc3 = nn.Linear(84, 10)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.elu(self.conv1(x)), 2)
+        x = F.max_pool2d(F.elu(self.conv2(x)), 2)
+        x = x.flatten(1)
+        x = F.elu(self.fc1(x))
+        x = F.elu(self.fc2(x))
+        return self.fc3(x)
+
+
 def main() -> None:
     torch.manual_seed(0)
-    k, batch = 3, 32
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    # WORKLOAD=simple: the federated_trio.py config (Net, batch 512,
+    # reference src/federated_trio.py:18); default: the resnet flagship
+    simple = os.environ.get("WORKLOAD") == "simple"
+    k = 3
+    batch = 512 if simple else 32
+    steps = int(os.environ.get("BENCH_STEPS", "3" if simple else "10"))
 
-    nets = [_ResNet18() for _ in range(k)]
+    nets = [(_Net if simple else _ResNet18)() for _ in range(k)]
     opts = [
         LBFGSNew(
             n.parameters(),
@@ -120,17 +145,37 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     sps = steps * k * batch / dt
-    out = {
+    row = {
         "samples_per_sec": round(sps, 2),
         "sec_per_lockstep_minibatch": round(dt / steps, 3),
-        "workload": "3-client ResNet18-class CIFAR shapes, batch 32, "
-        "LBFGSNew(history=10, max_iter=4, line_search, batch_mode), torch CPU",
+        "workload": (
+            "3-client simple-CNN (Net), batch 512"
+            if simple
+            else "3-client ResNet18-class CIFAR shapes, batch 32"
+        )
+        + ", LBFGSNew(history=10, max_iter=4, line_search, batch_mode), "
+        "torch CPU",
         "host": os.uname().nodename,
     }
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "reference_throughput.json")
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "reference_throughput.json"
+    )
+    # the flagship (resnet) row keeps the top-level keys bench.py reads;
+    # the simple-CNN row lives under its own key
+    merged = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+        except Exception:
+            merged = {}
+    if simple:
+        merged["simple_cnn_batch512"] = row
+    else:
+        merged.update(row)
     with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps(out))
+        json.dump(merged, f, indent=1)
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

@@ -69,3 +69,4 @@ def test_within_band_parity_passes_all_primary_criteria():
     assert v["framework_ge_reference_minus_band"]
     assert v["both_above_2x_chance"]
     assert v["acc_final_within_band"]
+    assert v["primary_pass"]
