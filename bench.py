@@ -5,7 +5,9 @@ the last line of a bounded stdout tail, so it must stay short):
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
    "mfu": ..., "mxu_pct_peak": ...}
 The full record (roofline, sweep, MXU probe) is written to
-`benchmarks/bench_full.json`.
+`benchmarks/bench_full.json` (gitignored scratch — a per-round snapshot
+`benchmarks/bench_full_r{N}.json` is committed so the docs' cited
+evidence lives in the repo).
 
 The hot loop is the jitted sharded epoch function — every client's
 stochastic L-BFGS step (up to 4 inner iterations, Armijo line-search

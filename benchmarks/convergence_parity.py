@@ -435,17 +435,22 @@ def _log_ratio_band(fw, ref):
     )
 
 
-def compare(fw, ref, strategy, acc_band=0.05):
+def compare(fw, ref, strategy, acc_band=0.05, num_classes=10):
     """`acc_band` is the final-accuracy tolerance: all four configs run
     their FULL schedule until both sides sit well above chance, where a
     0.05 band on the plateau is a meaningful oracle (a wrong consensus
     step costs more than that; shuffle noise costs less).
+
+    `num_classes` sets the chance floor (1/num_classes) for the
+    above-2x-chance sanity check — a 100-class config must clear 0.02,
+    not inherit the 10-class 0.2 bar.
     """
     fa, ra = _mean_curve(fw["acc"]), _mean_curve(ref["acc"])
     m = min(len(fa), len(ra))
     diffs = [abs(f - r) for f, r in zip(fa[:m], ra[:m])]
-    chance = 0.1  # 10 classes
+    chance = 1.0 / num_classes
     out = {
+        "num_classes": num_classes,
         "final_acc": {"framework": fa[-1], "reference": ra[-1]},
         "final_acc_diff": round(abs(fa[-1] - ra[-1]), 4),
         "mean_acc_diff": round(float(np.mean(diffs)), 4),
@@ -538,7 +543,8 @@ def main():
                 "mean_rho": ref["mean_rho"],
             },
         },
-        "verdict": compare(fw, ref, c["strategy"], c["acc_band"]),
+        "verdict": compare(fw, ref, c["strategy"], c["acc_band"],
+                           num_classes=c.get("num_classes", 10)),
     }
 
     merged = {}

@@ -232,6 +232,34 @@ class ExperimentConfig:
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)
 
+    def __hash__(self):
+        # frozen dataclasses generate __hash__ from raw field values, and
+        # the dict-valued model_kwargs would make that raise TypeError the
+        # first time a config is used as a dict key / set member / jit
+        # static argument. Canonicalize containers recursively (sorted by
+        # repr so mixed-type dict keys stay orderable) so configs remain
+        # hashable whatever model_kwargs holds; an explicit __hash__
+        # suppresses the generated one (dataclass hash_action table:
+        # has_explicit_hash).
+        def canon(v):
+            if isinstance(v, dict):
+                return tuple(
+                    sorted(
+                        ((canon(k), canon(x)) for k, x in v.items()),
+                        key=repr,
+                    )
+                )
+            if isinstance(v, (list, tuple, set, frozenset)):
+                items = tuple(canon(x) for x in v)
+                return tuple(sorted(items, key=repr)) if isinstance(
+                    v, (set, frozenset)
+                ) else items
+            return v
+
+        return hash(
+            tuple(canon(getattr(self, f.name)) for f in dataclasses.fields(self))
+        )
+
 
 # The five reference driver scripts as presets. Loop sizes, batch sizes,
 # rho, and flags are each script's module constants (citations per field

@@ -55,7 +55,11 @@ from federated_pytorch_test_tpu.optim import (
     lbfgs_step,
     vma_zero,
 )
-from federated_pytorch_test_tpu.parallel import CLIENT_AXIS, mark_varying
+from federated_pytorch_test_tpu.parallel import (
+    CLIENT_AXIS,
+    mark_varying,
+    path_component_name,
+)
 from federated_pytorch_test_tpu.partition import Partition
 
 PyTree = Any
@@ -146,8 +150,7 @@ def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labe
                 updated.get("intermediates", {})
             )[0]
             if any(
-                getattr(k, "key", getattr(k, "name", None)) == "moe_aux"
-                for k in path
+                path_component_name(k) == "moe_aux" for k in path
             )
         ]
         if aux:

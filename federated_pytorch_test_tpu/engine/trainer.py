@@ -510,6 +510,49 @@ class Trainer:
             [self._fetch(l) for l in losses], axis=0
         )
 
+    def compile_round(self, gid: int) -> float:
+        """AOT-compile one group's jitted programs WITHOUT executing the
+        epoch.
+
+        Lowers the epoch and consensus programs against the real round
+        arguments (`jax.jit(...).lower(...).compile()` — no execution, no
+        donation) so they land in the persistent XLA compile cache
+        (utils/hostcpu.py). A later run of the same config pays only
+        execution — the seeding half of the dryrun's two-phase scale64
+        budget gate (`__graft_entry__.py`). Returns seconds spent.
+
+        The cheap `init_fn` does execute: its outputs are the lowering
+        arguments for the epoch program, and its own compile is seconds.
+        """
+        t0 = time.perf_counter()
+        epoch_fn, consensus_fn, init_fn = self._fns(gid)
+        if self._stream:
+            raise NotImplementedError(
+                "compile_round seeds the resident epoch program; streaming "
+                "epochs compile per-chunk shapes at first use instead"
+            )
+        lstate, y, z, rho, extra = init_fn(self.flat)
+        idx = self._epoch_indices(0, gid, 0, 0)
+        cap = self.cfg.max_scan_steps
+        slices = [idx]
+        if cap is not None and idx.shape[0] > cap:
+            # chunked epochs execute [cap, K, B] slices plus one remainder
+            # slice — both shapes must be seeded or the warm run still
+            # pays a cold compile on the tail
+            slices = [idx[:cap]]
+            if idx.shape[0] % cap:
+                slices.append(idx[: idx.shape[0] % cap])
+        for sl in slices:
+            epoch_fn.lower(
+                self.flat, lstate, self.stats, self.shard_imgs,
+                self.shard_labels, sl, self.mean, self.std, y, z, rho,
+            ).compile()
+        if consensus_fn is not None:
+            consensus_fn.lower(
+                self.flat, y, z, rho, extra, jnp.int32(0)
+            ).compile()
+        return time.perf_counter() - t0
+
     def run_round(self, nloop: int, gid: int) -> None:
         """One partition group's full round: init, Nadmm x (epochs + consensus)."""
         cfg = self.cfg

@@ -55,6 +55,8 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     client_sharding,
     largest_feasible_mesh,
     mesh_size,
+    path_component_name,
+    path_names,
     replicate,
     replicated_sharding,
     shard_clients,
@@ -99,5 +101,7 @@ __all__ = [
     "replicate",
     "replicated_sharding",
     "shard_clients",
+    "path_component_name",
+    "path_names",
     "weighted_client_mean",
 ]

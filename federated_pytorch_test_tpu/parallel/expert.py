@@ -16,7 +16,12 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS, mesh_1d, mesh_2d
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    mesh_1d,
+    mesh_2d,
+    path_names,
+)
 
 EXPERT_AXIS = "experts"
 
@@ -52,18 +57,15 @@ def ep_param_specs(tree: PyTree, n_experts: int, client_axis: bool = False) -> P
     `clients` axis prepended.
     """
 
-    def _names(path):
-        return tuple(getattr(k, "key", getattr(k, "name", None)) for k in path)
-
     # nodes that contain a `gate` submodule: their direct children are
     # MoEMLP's params (leaf paths look like <node>/gate/kernel)
     leaf_paths = [
-        _names(p) for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+        path_names(p) for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
     ]
     gate_scopes = {p[:-2] for p in leaf_paths if len(p) >= 2 and p[-2] == "gate"}
 
     def spec(path, leaf):
-        names = _names(path)
+        names = path_names(path)
         in_moe = names[:-1] in gate_scopes or any(
             isinstance(n, str) and "moe" in n.lower() for n in names[:-1]
         )

@@ -31,6 +31,27 @@ CLIENT_AXIS = "clients"
 PyTree = Any
 
 
+def path_component_name(key) -> Any:
+    """The name of one tree-path component, whatever its key kind.
+
+    Flax dict params yield `DictKey(.key)`, attribute-style trees yield
+    `GetAttrKey(.name)`, and list/tuple children yield `SequenceKey(.idx)`
+    — the latter has neither `.key` nor `.name`, so naive
+    `getattr(k, "key", ...)` chains silently return None for them (and
+    None entries make mixed path tuples unsortable). Returns the string
+    name where one exists, else the integer sequence index, else None.
+    """
+    name = getattr(key, "key", getattr(key, "name", None))
+    if name is None:
+        name = getattr(key, "idx", None)
+    return name
+
+
+def path_names(path) -> tuple:
+    """`path_component_name` over a full tree path, as a tuple."""
+    return tuple(path_component_name(k) for k in path)
+
+
 def mesh_1d(
     axis: str,
     n_devices: int | None = None,

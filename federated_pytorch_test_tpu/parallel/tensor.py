@@ -44,7 +44,12 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS, mesh_1d, mesh_2d
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    mesh_1d,
+    mesh_2d,
+    path_names,
+)
 
 MODEL_AXIS = "model"
 
@@ -93,7 +98,7 @@ def _leaf_spec(path, ndim: int) -> P:
     `ndim` is the rank of the leaf WITHOUT any leading client axis — the
     caller strips it for client-stacked trees.
     """
-    names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+    names = path_names(path)
     _, layer = _layer_of(names)
     leaf_name = names[-1] if names else None
     if layer is None:
@@ -152,7 +157,7 @@ def tp_param_specs(
     if mesh is not None:
 
         def scan(path, leaf):
-            names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+            names = path_names(path)
             idx, layer = _layer_of(names)
             if layer is None:
                 return
@@ -163,7 +168,9 @@ def tp_param_specs(
                 demoted.add(tuple(names[: idx + 1]))
 
         jax.tree_util.tree_map_with_path(scan, tree)
-        for scope in sorted(demoted):
+        # key=str: scopes can mix str and int (SequenceKey) components,
+        # which plain tuple comparison cannot order
+        for scope in sorted(demoted, key=str):
             partner = _PAIR.get(scope[-1])
             if partner and scope[:-1] + (partner,) not in demoted:
                 import warnings
@@ -187,7 +194,7 @@ def tp_param_specs(
         )
 
     def spec(path, leaf):
-        names = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        names = path_names(path)
         s = _leaf_spec(path, leaf.ndim - 1 if client_axis else leaf.ndim)
         if mesh is not None and (
             _pair_demoted(names)

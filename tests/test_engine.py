@@ -723,3 +723,34 @@ def test_diag_forward_forced_on_for_batch_stats_models():
                synthetic_n_train=48, synthetic_n_test=24)
     tr = Trainer(cfg, verbose=False, source=None)
     assert tr._ctx(tr.group_order[0]).diag_forward is True
+
+
+def test_config_is_hashable_with_model_kwargs():
+    # frozen dataclasses derive __hash__ from raw field values; the
+    # dict-valued model_kwargs would raise TypeError the first time a
+    # config lands in a set / dict key / jit static arg (ADVICE r4).
+    a = tiny("fedavg", model="vit", model_kwargs={"moe_experts": 4})
+    b = tiny("fedavg", model="vit", model_kwargs={"moe_experts": 4})
+    c = tiny("fedavg", model="vit", model_kwargs={"moe_experts": 8})
+    assert hash(a) == hash(b) and a == b
+    assert a != c
+    assert len({a, b, c}) == 2
+
+
+def test_compile_round_seeds_cache_without_training():
+    # the dryrun's compile-only scale64 seeding pass: lower+compile the
+    # epoch program, touch no parameters, and leave the trainer able to
+    # run the identical round afterwards (cache hit, same trajectory as
+    # an un-seeded twin).
+    cfg = tiny("fedavg", model="net", nadmm=1)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    gid = tr.group_order[0]
+    before = np.asarray(tr.flat).copy()
+    tr.compile_round(gid)
+    assert np.array_equal(np.asarray(tr.flat), before), (
+        "compile_round must not execute a training step"
+    )
+    tr.run_round(nloop=0, gid=gid)
+    twin = Trainer(cfg, verbose=False, source=SRC)
+    twin.run_round(nloop=0, gid=gid)
+    np.testing.assert_array_equal(np.asarray(tr.flat), np.asarray(twin.flat))

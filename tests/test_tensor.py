@@ -274,3 +274,41 @@ def test_tp_pair_demotion_keeps_megatron_pairs_consistent():
     specs2 = tp_param_specs(tree, mesh=mesh2)
     assert specs2["attn"]["qkv"]["kernel"] == P(None, MODEL_AXIS)
     assert specs2["attn"]["proj"]["kernel"] == P(MODEL_AXIS, None)
+
+
+def test_tp_specs_handle_list_nested_submodules():
+    # list/tuple children flatten to SequenceKey path entries, which have
+    # neither .key nor .name: naive name extraction yielded None there and
+    # made mixed demoted-scope tuples unsortable (ADVICE r4). Two
+    # non-divisible pairs nested under a LIST must both demote, warning,
+    # without a TypeError from sorting the demotion set.
+    from jax.sharding import PartitionSpec as P
+
+    block = {
+        "qkv": {
+            "kernel": np.zeros((8, 24), np.float32),
+            "bias": np.zeros((24,), np.float32),
+        },
+        "proj": {
+            "kernel": np.zeros((8, 8), np.float32),
+            "bias": np.zeros((8,), np.float32),
+        },
+    }
+    tree = {"blocks": [block, block], "head": {
+        "kernel": np.zeros((8, 10), np.float32)}}
+    mesh = model_mesh(3)
+    with pytest.warns(UserWarning, match="demoting its Megatron partner"):
+        specs = tp_param_specs(tree, mesh=mesh)
+    assert all(s == P() for s in jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)))
+
+
+def test_path_names_cover_all_key_kinds():
+    from federated_pytorch_test_tpu.parallel import path_names
+
+    tree = {"a": [np.zeros(1), {"b": np.zeros(1)}]}
+    paths = [
+        path_names(p)
+        for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+    assert paths == [("a", 0), ("a", 1, "b")]
