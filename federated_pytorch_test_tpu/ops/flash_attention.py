@@ -78,12 +78,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
 
+_LOG2E = 1.4426950408889634  # 1/ln 2: exp(x) == exp2(x * _LOG2E)
+_LN2 = 0.6931471805599453
+
 # Default tile heights; S must be a multiple of the resolved tile (the
 # LM/ViT sequence lengths are powers of two — raise, don't silently pad,
 # so callers see the constraint). 128 is the MXU systolic edge and the
 # floor; at D=64 a 128-row tile leaves every grid step overhead-dominated
 # (~1 us/step vs ~20 ns of MXU work), so the defaults are larger — see
-# benchmarks/long_context_tpu.json for the measured sweep on a v5e.
+# benchmarks/flash_bf16_tiles.json for the measured sweep on a v5e.
+# `flash_attention` upgrades the default to 1024 for bf16 inputs at
+# D <= 64 (measured best; bf16 halves tile VMEM so 1024 compiles).
 # Both public entries take block_q/block_k overrides.
 _BQ = 512
 _BK = 512
@@ -103,6 +108,11 @@ _FF = ((0,), (0,))
 
 
 def _dot(a, b, dims, prec=_HI):
+    if a.dtype != b.dtype:
+        # mixed tiles (bf16 residuals dotted against f32 cotangents):
+        # promote both sides — dot_general requires matching dtypes
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
     return jax.lax.dot_general(
         a, b, (dims, ((), ())), preferred_element_type=jnp.float32,
         precision=prec,
@@ -147,8 +157,11 @@ def _run_unless_skipped(causal, keep_pred, compute):
 def _online_softmax_update(sc, m, l, o, v, prec, guard_masked_rows: bool):
     """Fold one score tile into the (m, l, o) online-softmax accumulators.
 
-    The single copy of the numerically delicate update, shared by the
-    rectangular and triangular forward kernels. `guard_masked_rows` zeroes
+    Used by the rectangular (offset/ring) forward kernel; the triangular
+    kernel carries its own exp2-domain copy of this recurrence with the
+    round-5 layout changes (fused denominator, slice-written statistics —
+    `_fwd_kernel_tri`). A numerical fix here likely applies there too.
+    `guard_masked_rows` zeroes
     rows whose running max is still _NEG_BIG — they have seen only masked
     scores (sc - m_new == 0 there, NOT -inf), possible for non-tile-
     aligned offsets in the OFFSET path; the ALIGNED triangular path never
@@ -238,41 +251,96 @@ def _tri_tables_kmajor(nq: int):
 
 
 def _fwd_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                    o_acc, m_acc, l_acc, *, bq: int, scale: float, prec):
+                    acc, m_acc, l_acc, *, bq: int, d: int, cast16: bool,
+                    fuse_l: bool, prec):
+    """VPU-lean aligned-causal forward (the measured redesign, round 5).
+
+    The round-3 kernel spent ~80% of its step in VPU softmax work, not
+    in the D=64 half-filled MXU dots the round-4 ceiling analysis blamed
+    (attribution in `benchmarks/flash_attrib_probe.json`). Measured
+    changes, largest first:
+
+    * `fuse_l` (bf16 inputs, D not a lane multiple): `v_ref` is V with a
+      ones column appended at `d` (then zero-padded to the 128-lane
+      multiple): `p @ v` accumulates the softmax denominator l into
+      `acc[:, d]` inside the SAME MXU dot that accumulates o — the
+      separate [BQ, BK] rowsum pass and the l scratch disappear. Free
+      exactly when the single-pass bf16 PV dot pads its output to the
+      next 128 lanes anyway; at f32 precisions the wider dot costs real
+      passes (measured: +29% on a 'highest' forward), so those take the
+      plain path with an l scratch (`l_acc`, ignored otherwise).
+    * the running max / denominator write back as [BQ, 1] lane slices
+      instead of broadcast [BQ, 128] stores (~20% of the old step time).
+    * scores live in base 2 — Q arrives pre-scaled by scale*log2(e), so
+      `exp2` replaces `exp` and the flush converts lse back to natural
+      log (lse_nat = lse2 * ln2); the public contract is unchanged.
+
+    With `cast16` the probability tile feeds the MXU in bf16 (inputs
+    were bf16 and the caller asked for 'default' precision — the same
+    rounding class XLA's dense softmax@V takes on that path). A
+    diagonal-only causal mask via `lax.cond` was tried and reverted:
+    Mosaic's cond costs more than the masked-tile arithmetic it saves
+    (measured: +50% on the backward, where it ran per recompute tile).
+    """
     p_id = pl.program_id(1)
     i = itab[p_id]
     j = jtab[p_id]
 
     @pl.when(j == 0)
     def _():
-        o_acc[:] = jnp.zeros_like(o_acc)
+        acc[:] = jnp.zeros_like(acc)
         m_acc[:] = jnp.full_like(m_acc, _NEG_BIG)
-        l_acc[:] = jnp.zeros_like(l_acc)
+        if not fuse_l:
+            l_acc[:] = jnp.zeros_like(l_acc)
 
-    q = q_ref[0] * scale  # [BQ, D]
-    sc = _dot(q, k_ref[0], _LL, prec)  # [BQ, BK]
-    # the mask is the identity on sub-diagonal tiles (j < i): one formula
-    # serves every pair, and aligned diagonals guarantee every row sees
-    # its own key, so no fully-masked-row guard is needed here
+    sc = _dot(q_ref[0], k_ref[0], _LL, prec)  # [BQ, BK], base-2 domain
     sc = _causal_mask(sc, i * bq, j * bq)
-    m_new, l_new, o_new = _online_softmax_update(
-        sc, m_acc[:, 0], l_acc[:, 0], o_acc[:], v_ref[0], prec,
-        guard_masked_rows=False,
-    )
-    o_acc[:] = o_new
-    m_acc[:] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
-    l_acc[:] = jnp.broadcast_to(l_new[:, None], l_acc.shape)
+    m = m_acc[:, 0]
+    m_new = jnp.maximum(m, jnp.max(sc, axis=1))
+    p = jnp.exp2(sc - m_new[:, None])
+    if cast16:
+        p = p.astype(jnp.bfloat16)
+    corr = jnp.exp2(m - m_new)
+    acc[:] = acc[:] * corr[:, None] + _dot(p, v_ref[0], _LF, prec)
+    if not fuse_l:
+        l_acc[:, 0:1] = (
+            l_acc[:, 0] * corr + jnp.sum(p.astype(jnp.float32), axis=1)
+        )[:, None]
+    m_acc[:, 0:1] = m_new[:, None]
 
     @pl.when(j == i)
     def _():
-        l = jnp.maximum(l_acc[:, 0], 1e-30)
-        o_ref[0] = o_acc[:] / l[:, None]
-        lse_ref[0] = (m_acc[:, 0] + jnp.log(l))[:, None]
+        a = acc[:]
+        l = jnp.maximum(a[:, d] if fuse_l else l_acc[:, 0], 1e-30)
+        o_ref[0] = a[:, :d] / l[:, None]
+        lse_ref[0] = ((m_acc[:, 0] + jnp.log2(l)) * _LN2)[:, None]
+
+
+def _p_ds_tile_tri(q, k, v, do, lse, delta, i, j, bq, prec, cast16):
+    """P and dS for one triangular-grid tile, in the base-2 domain.
+
+    `q` arrives pre-scaled by scale*log2(e) (as in the forward), so the
+    raw dot IS the base-2 score and `exp2` recovers the exact softmax
+    P = exp2(s2 - lse*log2e) = exp(s_nat - lse); `lse` stays natural-log
+    (the public contract) and converts per row. P and dP are domain-free,
+    so the returned dS = P*(dP - delta) is the ordinary NATURAL-domain
+    flash-2 cotangent dL/ds_nat — only the callers' final constant
+    multiplies account for the q pre-scaling (see the flush comments).
+    With `cast16`, P and dS feed the MXU in bf16.
+    """
+    sc = _causal_mask(_dot(q, k, _LL, prec), i * bq, j * bq)
+    p = jnp.exp2(sc - (lse * _LOG2E)[:, None])
+    dp = _dot(do, v, _LL, prec)
+    ds = p * (dp - delta[:, None])
+    if cast16:
+        p = p.astype(jnp.bfloat16)
+        ds = ds.astype(jnp.bfloat16)
+    return p, ds
 
 
 def _bwd_dq_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, do_ref, lse_ref,
                        delta_ref, dq_ref, dq_acc, *, bq: int, scale: float,
-                       prec):
+                       cast16: bool, prec):
     p_id = pl.program_id(1)
     i = itab[p_id]
     j = jtab[p_id]
@@ -282,18 +350,21 @@ def _bwd_dq_kernel_tri(itab, jtab, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     k = k_ref[0]
-    _, ds = _p_ds_tile(q_ref[0], k, v_ref[0], do_ref[0], lse_ref[0][:, 0],
-                       delta_ref[0][:, 0], i * bq, j * bq, True, scale, prec)
+    _, ds = _p_ds_tile_tri(q_ref[0], k, v_ref[0], do_ref[0],
+                           lse_ref[0][:, 0], delta_ref[0][:, 0], i, j, bq,
+                           prec, cast16)
     dq_acc[:] = dq_acc[:] + _dot(ds, k, _LF, prec)
 
     @pl.when(j == i)
     def _():
+        # ds is natural-domain and k is unscaled: dL/dq = scale*(ds @ k),
+        # exactly as in the offset-path kernel
         dq_ref[0] = dq_acc[:] * scale
 
 
 def _bwd_dkv_kernel_tri(jtab, itab, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                        *, nq: int, bq: int, scale: float, prec):
+                        *, nq: int, bq: int, cast16: bool, prec):
     p_id = pl.program_id(1)
     j = jtab[p_id]
     i = itab[p_id]
@@ -305,14 +376,19 @@ def _bwd_dkv_kernel_tri(jtab, itab, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     q = q_ref[0]
     do = do_ref[0]
-    p, ds = _p_ds_tile(q, k_ref[0], v_ref[0], do, lse_ref[0][:, 0],
-                       delta_ref[0][:, 0], i * bq, j * bq, True, scale, prec)
+    p, ds = _p_ds_tile_tri(q, k_ref[0], v_ref[0], do, lse_ref[0][:, 0],
+                           delta_ref[0][:, 0], i, j, bq, prec, cast16)
+    # under cast16, dO was cast to bf16 at HBM level in _bwd_tri, so
+    # this dot is already bf16 x bf16
     dv_acc[:] = dv_acc[:] + _dot(p, do, _FF, prec)
     dk_acc[:] = dk_acc[:] + _dot(ds, q, _FF, prec)
 
     @pl.when(i == nq - 1)
     def _():
-        dk_ref[0] = dk_acc[:] * scale
+        # the q tile is PRE-SCALED by scale2 = scale*log2e, so the
+        # accumulated ds^T @ q_scaled = scale2*(ds^T @ q); the true
+        # dL/dk = scale*(ds^T @ q) = (scale/scale2)*acc = ln2 * acc
+        dk_ref[0] = dk_acc[:] * _LN2
         dv_ref[0] = dv_acc[:]
 
 
@@ -448,25 +524,51 @@ def _grid_spec(grid, in_specs, out_specs, scratch_shapes):
     )
 
 
-def _fwd_tri(q3, k3, v3, scale: float, vma, prec, bq: int):
+def _augmented_v(v3, d: int, da: int):
+    """V with a ones column at `d`, zero-padded to `da` lanes (the fused
+    softmax-denominator operand — see `_fwd_kernel_tri`)."""
+    bh, s, _ = v3.shape
+    parts = [v3, jnp.ones((bh, s, 1), v3.dtype)]
+    if da > d + 1:
+        parts.append(jnp.zeros((bh, s, da - d - 1), v3.dtype))
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _prescale_q(q3, scale: float):
+    """Q pre-scaled into the base-2 score domain (one f32 multiply in
+    HBM, so bf16 inputs round once rather than per tile)."""
+    return (q3.astype(jnp.float32) * (scale * _LOG2E)).astype(q3.dtype)
+
+
+def _fwd_tri(q3, k3, v3, scale: float, vma, prec, bq: int, cast16: bool):
     """Aligned-causal forward on the triangular pair grid."""
     bh, s_q, d = q3.shape
     nq = s_q // bq
+    # fused softmax denominator: only where the wider PV dot is free —
+    # the single-pass bf16 probability dot (cast16) with D below the next
+    # 128-lane boundary (see the kernel docstring). bf16 inputs at
+    # 'highest' precision keep f32 probabilities, so they take the plain
+    # l-scratch path like f32 — the fused dot would pay the multi-pass
+    # wider-N cost there.
+    fuse_l = cast16 and d % 128 != 0
+    da = ((d + 1) + 127) // 128 * 128 if fuse_l else d
     itab, jtab = _tri_tables_qmajor(nq)
     qspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, it[p], 0))
-    kvspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, jt[p], 0))
+    kspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, jt[p], 0))
+    vspec = pl.BlockSpec((1, bq, da), lambda b, p, it, jt: (b, jt[p], 0))
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel_tri, bq=bq, scale=scale, prec=prec),
+        functools.partial(_fwd_kernel_tri, bq=bq, d=d, cast16=cast16,
+                          fuse_l=fuse_l, prec=prec),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, itab.shape[0]),
-            in_specs=[qspec, kvspec, kvspec],
+            in_specs=[qspec, kspec, vspec],
             out_specs=[
                 qspec,
                 pl.BlockSpec((1, bq, 1), lambda b, p, it, jt: (b, it[p], 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, da), jnp.float32),
                 pltpu.VMEM((bq, 128), jnp.float32),
                 pltpu.VMEM((bq, 128), jnp.float32),
             ],
@@ -476,16 +578,18 @@ def _fwd_tri(q3, k3, v3, scale: float, vma, prec, bq: int):
             jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32, vma=vma),
         ],
         interpret=_interpret(),
-    )(jnp.asarray(itab), jnp.asarray(jtab), q3, k3, v3)
+    )(jnp.asarray(itab), jnp.asarray(jtab), _prescale_q(q3, scale), k3,
+      _augmented_v(v3, d, da) if fuse_l else v3)
     return o, lse
 
 
 def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI,
-         aligned: bool = False, bq: int = _BQ, bk: int = _BK):
+         aligned: bool = False, bq: int = _BQ, bk: int = _BK,
+         cast16: bool = False):
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
     if causal and aligned and s_q == s_kv and bq == bk:
-        return _fwd_tri(q3, k3, v3, scale, vma, prec, bq)
+        return _fwd_tri(q3, k3, v3, scale, vma, prec, bq, cast16)
     nq, nkv = s_q // bq, s_kv // bk
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j, off: (b, i, 0))
     kvdx = (
@@ -516,28 +620,41 @@ def _fwd(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI,
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash3(q3, k3, v3, off, causal: bool, scale: float, vma=None, prec=_HI,
-            aligned: bool = False, bq: int = _BQ, bk: int = _BK):
-    return _fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk)
+            aligned: bool = False, bq: int = _BQ, bk: int = _BK,
+            cast16: bool = False):
+    return _fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk,
+                cast16)
 
 
-def _flash3_fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk):
-    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk)
+def _flash3_fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk,
+                cast16):
+    o, lse = _fwd(q3, k3, v3, off, causal, scale, vma, prec, aligned, bq, bk,
+                  cast16)
     return (o, lse), (q3, k3, v3, off, o, lse)
 
 
-def _bwd_tri(q3, k3, v3, do, lse, delta, scale: float, vma, prec, bq: int):
+def _bwd_tri(q3, k3, v3, do, lse, delta, scale: float, vma, prec, bq: int,
+             cast16: bool):
     """Aligned-causal backward on the triangular pair grids."""
     bh, s_q, d = q3.shape
     nq = s_q // bq
+    q3s = _prescale_q(q3, scale)  # kernels recompute base-2 scores
+    if cast16:
+        # one HBM-level cast instead of per-tile dtype promotions: with
+        # bf16 residuals, a f32 dO tile would force _dot to promote the
+        # V/P sides back to f32 inside every recompute tile (measured:
+        # the whole bf16 backward advantage disappeared into those casts)
+        do = do.astype(jnp.bfloat16)
 
     itab, jtab = _tri_tables_qmajor(nq)
     qspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, it[p], 0))
     q1spec = pl.BlockSpec((1, bq, 1), lambda b, p, it, jt: (b, it[p], 0))
     kvspec = pl.BlockSpec((1, bq, d), lambda b, p, it, jt: (b, jt[p], 0))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel_tri, bq=bq, scale=scale, prec=prec),
+        functools.partial(_bwd_dq_kernel_tri, bq=bq, scale=scale,
+                          cast16=cast16, prec=prec),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, itab.shape[0]),
@@ -547,14 +664,14 @@ def _bwd_tri(q3, k3, v3, do, lse, delta, scale: float, vma, prec, bq: int):
         ),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
         interpret=_interpret(),
-    )(jnp.asarray(itab), jnp.asarray(jtab), q3, k3, v3, do, lse, delta)
+    )(jnp.asarray(itab), jnp.asarray(jtab), q3s, k3, v3, do, lse, delta)
 
     jtab2, itab2 = _tri_tables_kmajor(nq)
     kspec = pl.BlockSpec((1, bq, d), lambda b, p, jt, it: (b, jt[p], 0))
     qstream = pl.BlockSpec((1, bq, d), lambda b, p, jt, it: (b, it[p], 0))
     q1stream = pl.BlockSpec((1, bq, 1), lambda b, p, jt, it: (b, it[p], 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel_tri, nq=nq, bq=bq, scale=scale,
+        functools.partial(_bwd_dkv_kernel_tri, nq=nq, bq=bq, cast16=cast16,
                           prec=prec),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -571,11 +688,11 @@ def _bwd_tri(q3, k3, v3, do, lse, delta, scale: float, vma, prec, bq: int):
             jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
         ],
         interpret=_interpret(),
-    )(jnp.asarray(jtab2), jnp.asarray(itab2), q3, k3, v3, do, lse, delta)
+    )(jnp.asarray(jtab2), jnp.asarray(itab2), q3s, k3, v3, do, lse, delta)
     return dq, dk, dv
 
 
-def _flash3_bwd(causal, scale, vma, prec, aligned, bq, bk, res, cts):
+def _flash3_bwd(causal, scale, vma, prec, aligned, bq, bk, cast16, res, cts):
     q3, k3, v3, off, o, lse = res
     do, dlse = cts
     bh, s_q, d = q3.shape
@@ -588,9 +705,9 @@ def _flash3_bwd(causal, scale, vma, prec, aligned, bq, bk, res, cts):
 
     if causal and aligned and s_q == s_kv and bq == bk:
         dq, dk, dv = _bwd_tri(q3, k3, v3, do, lse, delta, scale, vma, prec,
-                              bq)
+                              bq, cast16)
         doff = jax.custom_derivatives.zero_from_primal(off)
-        return dq, dk, dv, doff
+        return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype), doff
 
     # dq: outer = Q blocks, streamed = KV blocks
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j, off: (b, i, 0))
@@ -644,15 +761,20 @@ def _flash3_bwd(causal, scale, vma, prec, aligned, bq, bk, res, cts):
     )(off, q3, k3, v3, do, lse, delta)
 
     doff = jax.custom_derivatives.zero_from_primal(off)
-    return dq, dk, dv, doff
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype), doff
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-def _to3(x, b, h):
+def _to3(x, b, h, keep_bf16: bool = False):
     s = x.shape[1]
-    return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(jnp.float32)
+    dt = (
+        jnp.bfloat16
+        if keep_bf16 and x.dtype == jnp.bfloat16
+        else jnp.float32
+    )
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, -1).astype(dt)
 
 
 _PRECS = {
@@ -706,15 +828,35 @@ def flash_attention(
     equal tiles (the triangular grid pairs them).
     """
     b, s, h, d = q.shape
+    if block_q is None and block_k is None and (
+        q.dtype == jnp.bfloat16 and precision == "default" and causal
+        and d <= 64 and s % 1024 == 0
+    ):
+        # measured best tile for the configuration the sweep actually ran
+        # (flash_bf16_tiles.json round 5: causal fwd+bwd, bf16 tiles at
+        # 'default' precision, reference-scale head dims — 1024 beats 512
+        # by ~15% at S=4k and ~33% at S=8k; bf16 halves the tile VMEM
+        # that made 1024 uncompilable in round 4). Unmeasured shapes
+        # (f32, 'highest' — whose f32 probability tiles carry the VMEM
+        # class that fails compile at S=8k f32 — and the non-causal
+        # rectangular kernels) keep the 512 default.
+        block_q = block_k = 1024
     bq, bk = _resolve_blocks(s, s, d, block_q, block_k)
     if causal:
         bk = bq = min(bq, bk)  # triangular grid pairs equal tiles
     scale = _static_scale(sm_scale, d)
     off = jnp.zeros((2,), jnp.int32)
+    # bf16 inputs stay bf16 through the aligned kernels (half the tile
+    # DMA; accumulators and softmax statistics are f32 regardless), and
+    # at 'default' precision the probability tiles feed the MXU in bf16
+    # too — the same rounding class as XLA's dense softmax@V on that
+    # path (measured ~10% of the step, benchmarks/flash_attrib_probe.json)
+    cast16 = q.dtype == jnp.bfloat16 and precision == "default"
     # offsets are statically zero: causal takes the triangular grid
-    o, _ = _flash3(_to3(q, b, h), _to3(k, b, h), _to3(v, b, h),
+    o, _ = _flash3(_to3(q, b, h, True), _to3(k, b, h, True),
+                   _to3(v, b, h, True),
                    off, causal, scale, None, _prec_of(precision), True,
-                   bq, bk)
+                   bq, bk, cast16)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 

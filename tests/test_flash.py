@@ -331,3 +331,51 @@ def test_flash_long_context_values_stay_exact():
             np.asarray(out)[0, row, 0, :], p @ vn[: row + 1],
             rtol=3e-5, atol=3e-6, err_msg=f"row {row}",
         )
+
+
+def test_flash_bf16_inputs_match_dense():
+    # the round-5 bf16-resident path end to end: bf16 tiles stay bf16
+    # through the kernels (keep_bf16), the probability tile feeds the MXU
+    # in bf16 at 'default' precision (cast16), the fused softmax
+    # denominator rides the augmented-V dot (fuse_l), and s % 1024 == 0
+    # picks the measured 1024 default tile. Values and gradients must
+    # stay within bf16 rounding class of the f32 dense reference.
+    q, k, v = _qkv(b=1, s=1024, h=2, d=16, seed=13)
+    q16, k16, v16 = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = dense_attention(q, k, v, causal=True)
+    out = flash_attention(q16, k16, v16, causal=True, precision="default")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=0.06, atol=0.03
+    )
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, precision="default")
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q16, k16, v16)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        assert a.dtype == jnp.bfloat16, f"d{name} cotangent dtype"
+        denom = np.maximum(np.abs(np.asarray(b)), 1.0)
+        rel = np.max(np.abs(np.asarray(a, np.float32) - np.asarray(b)) / denom)
+        assert rel < 0.08, f"d{name} rel err {rel}"
+
+
+def test_flash_bf16_highest_precision_keeps_f32_probabilities():
+    # bf16 inputs with precision='highest' must NOT take the cast16/fuse_l
+    # shortcuts: probabilities stay f32, so values sit much closer to the
+    # f32 dense reference than the bf16-rounded default path
+    q, k, v = _qkv(b=1, s=256, h=1, d=16, seed=14)
+    q16, k16, v16 = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = dense_attention(
+        q16.astype(jnp.float32), k16.astype(jnp.float32),
+        v16.astype(jnp.float32), causal=True,
+    )
+    out = flash_attention(q16, k16, v16, causal=True, precision="highest")
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=8e-3
+    )
