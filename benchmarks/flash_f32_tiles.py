@@ -35,7 +35,7 @@ def main():
     reps = 3
     out = {"rows": []}
     for s in (1024, 2048):
-        inner = max(4, (8192 * 8192) // (s * s) * 4)
+        inner = max(16, (8192 * 8192) // (s * s) * 24)  # ~1 s of work/call (protocol v2)
         qs, ks, vs = (
             [jnp.asarray(rng.randn(B, s, H, D), jnp.float32)
              for _ in range(reps + 1)]

@@ -82,7 +82,7 @@ def main():
         # time dominates the flat ~0.1 s dispatch latency at every S
         # (protocol + step builder shared with flash_f32_tiles.py via
         # tpu_timing.py)
-        inner = max(4, (8192 * 8192) // (s * s) * 4)
+        inner = max(16, (8192 * 8192) // (s * s) * 24)  # ~1 s of work/call (protocol v2)
         make = lambda attn, prec: make_fwd_bwd_step(attn, prec, inner)
 
         row = {"seq_len": s, "inner_steps": inner}

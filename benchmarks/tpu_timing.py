@@ -64,15 +64,22 @@ def dispatch_floor() -> float:
     return best
 
 
-def timed(step, qs, ks, vs, reps, inner, floor_s: float = 0.0):
+def timed(step, qs, ks, vs, reps, inner, floor_s: float | None = None):
     """Best-of-`reps` PER-STEP time over distinct resident inputs.
 
     Input set 0 is burned on compile+warmup; sets 1..reps are each timed
     individually (scalar fetch = completion barrier) and the MINIMUM is
     reported: on the shared chip a single contended rep would otherwise
-    poison a mean. `floor_s` (see `dispatch_floor`) is subtracted from
-    each call's wall time before the per-step division.
+    poison a mean. The dispatch floor (see `dispatch_floor`) is
+    subtracted from each call's wall time before the per-step division —
+    measured here by default so EVERY caller of this harness is on the
+    v2 protocol; pass `floor_s` to reuse one measurement across many
+    `timed` calls. Callers must still size `inner` so the floor is a
+    small fraction of a call (the subtraction corrects the mean, not
+    the noise).
     """
+    if floor_s is None:
+        floor_s = dispatch_floor()
     float(step(qs[0], ks[0], vs[0]))
     best = float("inf")
     for i in range(1, reps + 1):

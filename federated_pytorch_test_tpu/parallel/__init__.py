@@ -44,6 +44,7 @@ from federated_pytorch_test_tpu.parallel.pipeline import (
 from federated_pytorch_test_tpu.parallel.tensor import (
     MODEL_AXIS,
     client_model_mesh,
+    client_model_seq_mesh,
     model_mesh,
     shard_params_tp,
     tp_param_specs,
@@ -79,6 +80,7 @@ __all__ = [
     "stack_stage_params",
     "stage_mesh",
     "client_model_mesh",
+    "client_model_seq_mesh",
     "model_mesh",
     "shard_params_tp",
     "tp_param_specs",

@@ -88,6 +88,23 @@ def mesh_2d(
     return Mesh(grid, axes)
 
 
+def mesh_3d(
+    axes: tuple[str, str, str],
+    d_outer: int,
+    d_mid: int,
+    d_inner: int,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """A 3-D `(outer, mid, inner)` mesh; inner is fastest-varying (see
+    `mesh_2d` for the adjacency rationale)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    need = d_outer * d_mid * d_inner
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, only {len(devs)} available")
+    grid = np.asarray(devs[:need]).reshape(d_outer, d_mid, d_inner)
+    return Mesh(grid, axes)
+
+
 def client_mesh(
     n_devices: int | None = None, devices: Sequence[jax.Device] | None = None
 ) -> Mesh:

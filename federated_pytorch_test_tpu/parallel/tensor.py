@@ -48,6 +48,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     mesh_1d,
     mesh_2d,
+    mesh_3d,
     path_names,
 )
 
@@ -82,6 +83,38 @@ def client_model_mesh(
     (engine/steps.py) and can afford the longer strides.
     """
     return mesh_2d((CLIENT_AXIS, MODEL_AXIS), d_clients, d_model, devices)
+
+
+def client_model_seq_mesh(
+    d_clients: int,
+    d_model: int,
+    d_seq: int,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """A 3-D `(clients, model, seq)` mesh: federated x tensor x sequence
+    parallelism composed.
+
+    The intended use is HYBRID shard_map: manual over `clients` (per-
+    client programs + consensus collectives) and `seq` (ring attention's
+    ppermute), auto over `model` — inside the body GSPMD completes the
+    Megatron row-parallel layers with all-reduces over `model` exactly
+    as on a pure `(clients, model)` mesh (jax.shard_map's `axis_names`
+    lists the manual axes; `tp_param_specs` works unchanged because it
+    only requires the mesh to CONTAIN the axes it shards). Proven
+    numerically identical to the per-client single-device reference in
+    tests/test_ring.py::test_three_axis_mesh_composes_tp_and_ring and in
+    the `triaxis` dryrun leg (__graft_entry__.py).
+
+    `seq` rides the innermost (physically adjacent) axis: ring
+    attention's per-step ppermute is bandwidth-critical and wants
+    neighbor hops; TP's all-reduce takes the middle axis; the per-round
+    consensus psum over `clients` is amortized across an epoch and can
+    afford the longest strides.
+    """
+    from federated_pytorch_test_tpu.parallel.ring import SEQ_AXIS
+
+    return mesh_3d((CLIENT_AXIS, MODEL_AXIS, SEQ_AXIS), d_clients, d_model,
+                   d_seq, devices)
 
 
 def _layer_of(names) -> tuple:
