@@ -32,12 +32,35 @@ from federated_pytorch_test_tpu.engine import Trainer, get_preset  # noqa: E402
 
 
 def main():
-    cfg = get_preset("fedavg_scale64")  # K=64 ResNet18 CIFAR100 (BASELINE #5)
+    cfg = get_preset(os.environ.get("PRESET", "fedavg_scale64"))
+    # dev-box dry run: shrink the preset through env overrides WITHOUT
+    # changing the recipe (same init -> mesh -> Trainer.run -> save path
+    # a pod runs); e.g. K=8 MODEL=net NLOOP=1 MAX_GROUPS=1 smoke-runs the
+    # script on a laptop's virtual mesh (tests/test_examples.py)
+    env_to_field = {
+        "K": ("n_clients", int),
+        "MODEL": ("model", str),
+        "NLOOP": ("nloop", int),
+        "NADMM": ("nadmm", int),
+        "BATCH": ("batch", int),
+        "NTRAIN": ("synthetic_n_train", int),
+        "NTEST": ("synthetic_n_test", int),
+        "MAX_GROUPS": ("max_groups", int),
+    }
+    over = {
+        field: cast(os.environ[name])
+        for name, (field, cast) in env_to_field.items()
+        if name in os.environ
+    }
+    if over:
+        cfg = cfg.replace(**over)
     mesh = multihost_client_mesh(cfg.n_clients)
     trainer = Trainer(cfg, verbose=(proc == 0), mesh=mesh)
     recorder = trainer.run()
     if proc == 0:
-        recorder.save("scale64_metrics.json")
+        out = os.environ.get("METRICS_OUT", "scale64_metrics.json")
+        recorder.save(out)
+        print(f"scale64 run complete -> {out}")
 
 
 if __name__ == "__main__":

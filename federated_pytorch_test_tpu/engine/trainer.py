@@ -192,28 +192,42 @@ class Trainer:
         )
         self._batchers = None
         if self._stream:
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "the streaming data path is single-process; shard the "
-                    "dataset across hosts instead"
-                )
             if cfg.eval_every_batch:
                 raise NotImplementedError(
                     "eval_every_batch needs the resident data path"
+                )
+            if cfg.save_model and jax.process_count() > 1:
+                # fail FAST: save() would raise this after a full outer
+                # loop of training otherwise (see save() for why)
+                raise NotImplementedError(
+                    "checkpointing a multi-process STREAMING run is not "
+                    "supported (no process holds the full-K stream "
+                    "positions); disable save_model or use the resident "
+                    "data path"
                 )
             from federated_pytorch_test_tpu.data.native import PrefetchBatcher
 
             self.shard_imgs = None
             self.shard_labels = None
-            self._batchers = [
-                PrefetchBatcher(
+            # HOST-SHARDED streaming (round-4 VERDICT item 8): each
+            # process batches only the clients whose mesh devices it
+            # owns — the natural extension of the per-client batchers.
+            # `_put`'s make_array_from_callback path then assembles the
+            # global chunk with each process supplying its own columns;
+            # streams are pure functions of (seed, batch, client), so
+            # any process layout produces the identical global data
+            # order (asserted against the single-process twin in
+            # tests/test_multiprocess.py).
+            self._stream_clients = self._local_clients()
+            self._batchers = {
+                c: PrefetchBatcher(
                     np.ascontiguousarray(self.fed.train_images[c]),
                     np.ascontiguousarray(self.fed.train_labels[c]),
                     cfg.batch,
                     seed=cfg.seed + 1000 + c,
                 )
-                for c in range(cfg.n_clients)
-            ]
+                for c in self._stream_clients
+            }
         else:
             self.shard_imgs = _put(self.fed.train_images, csh)
             self.shard_labels = _put(self.fed.train_labels, csh)
@@ -428,6 +442,25 @@ class Trainer:
                     f"non-finite parameters on clients {bad.tolist()} ({ctx})"
                 )
 
+    def _local_clients(self) -> list:
+        """Global client ids whose mesh devices belong to THIS process.
+
+        The 1-D `clients` mesh assigns each device a contiguous K/D
+        block of local clients (parallel/mesh.py folding); a client is
+        this process' iff its device is. Single-process: all of them.
+        """
+        devs = list(self.mesh.devices.flat)
+        if jax.process_count() == 1:
+            return list(range(self.cfg.n_clients))
+        per = self.cfg.n_clients // len(devs)
+        me = jax.process_index()
+        return [
+            c
+            for i, d in enumerate(devs)
+            if d.process_index == me
+            for c in range(i * per, (i + 1) * per)
+        ]
+
     def _run_stream_epoch(self, epoch_fn, lstate, y, z, rho):
         """One epoch through the host-streaming path, double-buffered.
 
@@ -446,16 +479,21 @@ class Trainer:
         sample_shape = tuple(self.fed.train_images.shape[2:])
 
         def assemble(n_steps):
+            # columns for clients owned by OTHER processes stay
+            # uninitialized: `_put`'s per-device callback only ever reads
+            # this process' own client columns (multi-host: each process
+            # supplies its shards; single-process: all clients are local
+            # and device_put reads everything)
             imgs = np.empty(
                 (n_steps, k, cfg.batch) + sample_shape,
                 self.fed.train_images.dtype,
             )
-            labs = np.empty((n_steps, k, cfg.batch), np.int32)
+            labs = np.zeros((n_steps, k, cfg.batch), np.int32)
             for s in range(n_steps):
-                for c in range(k):
+                for c in self._stream_clients:
                     im, lb = next(self._batchers[c])
                     imgs[s, c], labs[s, c] = im, lb
-            return jax.device_put(imgs, sh), jax.device_put(labs, sh)
+            return self._put(imgs, sh), self._put(labs, sh)
 
         remaining = s_total
         nxt = assemble(min(chunk, remaining))
@@ -730,17 +768,28 @@ class Trainer:
         if self._qkv_layout is not None:
             state["qkv_layout"] = np.int64(self._qkv_layout)
         if self._stream:
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "checkpointing a multi-process STREAMING run is not "
+                    "supported: each process holds only its own clients' "
+                    "stream positions, so no single process can write the "
+                    "full-K position vector (restore of a single-process "
+                    "streaming checkpoint onto a multi-process mesh IS "
+                    "supported — positions index by global client id)"
+                )
             # the streams are pure functions of (seed, batch, drop_last,
             # drawn-count) — the count IS the data-pipeline state
             state["stream_positions"] = np.asarray(
-                [b.drawn for b in self._batchers], np.int64
+                [self._batchers[c].drawn for c in sorted(self._batchers)],
+                np.int64,
             )
             # 1 = native batcher, 0 = numpy fallback (different streams),
             # saved PER BATCHER: a failed batcher_create falls back to
             # numpy even with the lib loaded, and a mixed run must not
             # collapse into either label
             state["stream_impl_native"] = np.asarray(
-                [b.is_native for b in self._batchers], np.int64
+                [self._batchers[c].is_native for c in sorted(self._batchers)],
+                np.int64,
             )
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
@@ -782,21 +831,24 @@ class Trainer:
                     "cannot seed the streaming batchers' positions "
                     "(rerun without hbm_data_budget_mb, or restart)"
                 )
-            impl = np.asarray(
-                [b.is_native for b in self._batchers], np.int64
-            )
             saved = np.asarray(state["stream_impl_native"]).reshape(-1)
-            if not np.array_equal(saved, impl):
-                raise ValueError(
-                    f"checkpoint stream positions were written under "
-                    f"per-client batcher impls {saved.tolist()} (1=native,"
-                    f" 0=numpy fallback) but this process built "
-                    f"{impl.tolist()} — the two permutation streams "
-                    "differ, so resuming would silently change the data "
-                    "order (set/unset FEDTPU_NO_NATIVE to match)"
-                )
-            for b, pos in zip(self._batchers, state["stream_positions"]):
-                b.skip(int(pos))
+            positions = np.asarray(state["stream_positions"]).reshape(-1)
+            # index by GLOBAL client id: this process may own a subset of
+            # the clients (host-sharded streaming) while the checkpoint
+            # carries the full-K vectors
+            for c in sorted(self._batchers):
+                b = self._batchers[c]
+                if int(saved[c]) != int(b.is_native):
+                    raise ValueError(
+                        f"checkpoint stream positions for client {c} were "
+                        f"written under batcher impl {int(saved[c])} "
+                        f"(1=native, 0=numpy fallback) but this process "
+                        f"built {int(b.is_native)} — the two permutation "
+                        "streams differ, so resuming would silently change "
+                        "the data order (set/unset FEDTPU_NO_NATIVE to "
+                        "match)"
+                    )
+                b.skip(int(positions[c]))
 
 
 def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> MetricsRecorder:

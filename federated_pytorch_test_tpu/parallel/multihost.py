@@ -84,15 +84,26 @@ def initialize_distributed(
     return jax.process_index()
 
 
-def _num_slices() -> int:
-    """Number of ICI-connected slices (DCN islands) in the global topology.
+def _dcn_islands() -> tuple[int, bool]:
+    """(number of DCN islands, islands-are-processes?).
 
-    TPU devices expose `slice_index`; one process per... is NOT assumed —
-    multi-host single-slice pods report one slice even with many
-    processes. Non-TPU backends count as a single slice.
+    TPU devices expose `slice_index` — ICI-connected slices are the
+    islands, however many processes drive them (multi-host single-slice
+    pods are ONE island). Backends without slice topology (CPU workers,
+    the CI multi-process harness) have no ICI at all: every process
+    boundary is the DCN analogue, so each process is its own island and
+    `mesh_utils` groups by process (`process_is_granule`).
     """
-    indices = {getattr(d, "slice_index", 0) for d in jax.devices()}
-    return max(1, len(indices))
+    devs = jax.devices()
+    slices = {getattr(d, "slice_index", None) for d in devs}
+    if None not in slices and len(slices) > 1:
+        return len(slices), False  # real multi-slice accelerator topology
+    if devs[0].platform == "cpu":
+        # no ICI anywhere (the distributed CPU backend reports a uniform
+        # slice_index 0, which says nothing): every process boundary is
+        # the DCN analogue
+        return max(1, jax.process_count()), True
+    return 1, False
 
 
 def multihost_client_mesh(n_clients: int) -> Mesh:
@@ -116,13 +127,14 @@ def multihost_client_mesh(n_clients: int) -> Mesh:
 
     from jax.experimental import mesh_utils
 
-    n_slices = _num_slices()
+    n_slices, by_process = _dcn_islands()
     per_slice = n_global // n_slices
     if n_slices > 1 and n_slices * per_slice == n_global:
         try:
             devices = mesh_utils.create_hybrid_device_mesh(
                 mesh_shape=(per_slice,),
                 dcn_mesh_shape=(n_slices,),
+                process_is_granule=by_process,
             )
             return Mesh(np.asarray(devices).reshape(-1), (CLIENT_AXIS,))
         except (ValueError, AssertionError) as e:

@@ -8,10 +8,11 @@ process supplies its own client shards), the consensus `psum` across the
 process boundary, and `_fetch` via `process_allgather`.
 
 Invoked as:
-    python tests/multiprocess_worker.py <process_id> <num_processes> <port>
+    python tests/multiprocess_worker.py <process_id> <num_processes> <port> \
+        [devices_per_process=4]
 
 Prints one line `RESULT <json>` with round metrics; the parent asserts
-both processes agree and match the single-process run bit-for-bit.
+all processes agree and match the single-process run bit-for-bit.
 """
 
 import json
@@ -23,10 +24,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    ndev = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    mode = sys.argv[5] if len(sys.argv) > 5 else "resident"
 
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
+        + f" --xla_force_host_platform_device_count={ndev}"
     )
     from federated_pytorch_test_tpu.utils import force_host_cpu
 
@@ -38,7 +41,7 @@ def main() -> None:
         cluster_detection_method="deactivate",
     )
     assert jax.process_count() == nproc, jax.process_count()
-    assert len(jax.devices()) == 4 * nproc
+    assert len(jax.devices()) == ndev * nproc
 
     import numpy as np
 
@@ -46,14 +49,42 @@ def main() -> None:
     from federated_pytorch_test_tpu.engine import Trainer, get_preset
     from federated_pytorch_test_tpu.parallel import multihost_client_mesh
 
-    k = 4 * nproc
+    # record whether the DCN-aware hybrid layout path actually built the
+    # mesh (multihost.py routes through mesh_utils when >1 island)
+    from jax.experimental import mesh_utils
+
+    hybrid_calls = []
+    _orig_hybrid = mesh_utils.create_hybrid_device_mesh
+
+    def _recording_hybrid(*args, **kwargs):
+        # record AFTER success: multihost.py catches a raising hybrid
+        # call and falls back to plain device order — that fallback must
+        # not read as "the hybrid path built this mesh"
+        result = _orig_hybrid(*args, **kwargs)
+        hybrid_calls.append(kwargs.get("dcn_mesh_shape"))
+        return result
+
+    mesh_utils.create_hybrid_device_mesh = _recording_hybrid
+
+    k = ndev * nproc
     src = synthetic_cifar(n_train=8 * k, n_test=2 * k)
+    over = {}
+    if mode == "stream":
+        # host-sharded streaming: every process batches only its own
+        # clients (engine/trainer.py assemble + _local_clients)
+        over = dict(hbm_data_budget_mb=0, stream_chunk_steps=1)
     cfg = get_preset(
         "fedavg", model="net", n_clients=k, batch=4, nloop=1, nadmm=1,
-        check_results=False,
+        check_results=False, **over,
     )
     mesh = multihost_client_mesh(k)
     tr = Trainer(cfg, verbose=False, source=src, mesh=mesh)
+    if mode == "stream":
+        assert tr._stream, "streaming mode did not engage"
+        assert len(tr._batchers) == ndev, (
+            "each process must batch ONLY its local clients",
+            sorted(tr._batchers),
+        )
     gid = tr.group_order[0]
     tr.run_round(nloop=0, gid=gid)
 
@@ -73,6 +104,7 @@ def main() -> None:
         "flat_sum": float(np.float64(flat.sum())),
         "accs": [float(a) for a in accs],
         "dual": float(tr.recorder.latest("dual_residual")),
+        "hybrid_dcn_shapes": hybrid_calls,
     }
     print("RESULT " + json.dumps(out), flush=True)
 

@@ -508,7 +508,7 @@ def test_streaming_data_path_trains():
     for seg in tr.partition.groups[gid]:
         blk = flat[:, seg.start : seg.start + seg.size]
         assert np.abs(blk - blk[:1]).max() == 0.0
-    for b in tr._batchers:
+    for b in tr._batchers.values():
         b.close()
 
 
@@ -606,14 +606,14 @@ def test_stream_resume_replays_exact_trajectory(tmp_path):
     tr_b = Trainer(cfg_b, verbose=False, source=src)
     tr_b.group_order = tr_b.group_order[:1]
     tr_b.run()
-    drawn_at_save = [b.drawn for b in tr_b._batchers]
+    drawn_at_save = [b.drawn for b in tr_b._batchers.values()]
     assert all(d > 0 for d in drawn_at_save)
 
     cfg_b2 = cfg_b.replace(nloop=2, load_model=True)
     tr_b2 = Trainer(cfg_b2, verbose=False, source=src)
     tr_b2.group_order = tr_b2.group_order[:1]
     assert tr_b2._completed_nloops == 1
-    assert [b.drawn for b in tr_b2._batchers] == drawn_at_save  # fast-forwarded
+    assert [b.drawn for b in tr_b2._batchers.values()] == drawn_at_save  # fast-forwarded
     rec_b2 = tr_b2.run()
 
     np.testing.assert_array_equal(
@@ -624,7 +624,7 @@ def test_stream_resume_replays_exact_trajectory(tmp_path):
         b_vals = [r["value"] for r in rec_b2.series[name]]
         assert a_vals == b_vals, name
     for tr in (tr_a, tr_b, tr_b2):
-        for b in tr._batchers:
+        for b in tr._batchers.values():
             b.close()
 
 

@@ -44,3 +44,82 @@ def test_federated_lm_example_learns():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "final per-client next-token accuracy" in proc.stdout
+
+
+def test_long_context_lm_example_runs_and_matches_dense():
+    # the sequence-parallel recipe as a user runs it: 8-device virtual
+    # ring, the script's own ring==dense loss identity, and two L-BFGS
+    # steps on the copy task (tiny SEQ keeps compiles in seconds)
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        JAX_PLATFORMS="cpu",
+        SEQ="64",
+        STEPS="2",
+        JAX_COMPILATION_CACHE_DIR=compile_cache_dir(),
+        TF_CPP_MIN_LOG_LEVEL="3",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "long_context_lm.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ring == dense loss check" in proc.stdout
+    # the two L-BFGS steps must improve the copy-task loss
+    lines = {
+        ln.split("=")[0].strip(): float(ln.split("=")[1].split()[0])
+        for ln in proc.stdout.splitlines()
+        if ln.startswith("loss[")
+    }
+    assert lines["loss[2]"] < lines["loss[0]"], proc.stdout
+
+
+def test_pod_scale64_example_smoke(tmp_path):
+    # the pod recipe script end to end on the dev box: the SAME
+    # initialize_distributed -> multihost_client_mesh -> Trainer.run ->
+    # recorder.save path a pod runs, shrunk via the script's env
+    # overrides (K=8 simple-CNN clients, one group, one round)
+    out = tmp_path / "scale64_metrics.json"
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        JAX_PLATFORMS="cpu",
+        # NTRAIN/NTEST only shrink the SYNTHETIC fallback; point the data
+        # root at an empty dir so a real archive on the host can't turn
+        # the smoke test into a full-CIFAR run
+        CIFAR_DATA_DIR=str(tmp_path / "no-archive-here"),
+        K="8",
+        MODEL="net",
+        NLOOP="1",
+        NADMM="1",
+        BATCH="4",
+        NTRAIN="64",
+        NTEST="16",
+        MAX_GROUPS="1",
+        METRICS_OUT=str(out),
+        JAX_COMPILATION_CACHE_DIR=compile_cache_dir(),
+        TF_CPP_MIN_LOG_LEVEL="3",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "pod_scale64.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "scale64 run complete" in proc.stdout
+    import json
+
+    rec = json.loads(out.read_text())  # MetricsRecorder.to_json: the series
+    # the scale64 presets run with check_results=False (throughput mode),
+    # so the recorded series are losses/residuals, not accuracies
+    assert rec["train_loss"], "no loss series recorded"
+    import math
+
+    assert all(
+        math.isfinite(v) for r in rec["train_loss"] for v in r["value"]
+    ), "non-finite training loss"

@@ -37,7 +37,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(nproc: int, timeout: float = 480.0):
+def _run_workers(nproc: int, timeout: float = 480.0, ndev: int = 4,
+                 mode: str = "resident"):
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
@@ -48,7 +49,8 @@ def _run_workers(nproc: int, timeout: float = 480.0):
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(i), str(nproc), str(port)],
+            [sys.executable, _WORKER, str(i), str(nproc), str(port),
+             str(ndev), mode],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -105,6 +107,88 @@ def test_two_process_fedavg_round_matches_single_process():
     tr.run_round(nloop=0, gid=gid)
     flat_sum = float(np.float64(np.asarray(tr._fetch(tr.flat)).sum()))
     accs = [float(a) for a in tr.evaluate()]
+
+    assert gid == r0["gid"]
+    np.testing.assert_allclose(flat_sum, r0["flat_sum"], rtol=1e-6)
+    np.testing.assert_allclose(accs, r0["accs"], rtol=0)
+
+
+def test_four_process_hybrid_mesh_matches_single_process():
+    # round-4 VERDICT item 4: the pod recipe's DCN-aware mesh layout runs
+    # under test, not just its 2-process special case. 4 OS processes x 2
+    # virtual devices join one 8-client mesh; on a sliceless backend each
+    # process boundary is a DCN island, so multihost_client_mesh routes
+    # through mesh_utils.create_hybrid_device_mesh (process_is_granule) —
+    # the worker records the call. The workload is IDENTICAL to the
+    # 2-process test (k=8, same data/config/seed), so the whole 4-way
+    # run must reproduce the same metrics as a single-process 8-device
+    # mesh: the layout path changes nothing numerically.
+    results = _run_workers(4, timeout=600.0, ndev=2)
+
+    r0 = results[0]
+    # the hybrid/DCN-aware layout path actually built this mesh (the
+    # worker's JSON round-trip makes the shape a list)
+    assert r0["hybrid_dcn_shapes"] == [[4]]
+    for r in results[1:]:
+        assert r["gid"] == r0["gid"]
+        np.testing.assert_allclose(r["flat_sum"], r0["flat_sum"], rtol=0)
+        np.testing.assert_allclose(r["accs"], r0["accs"], rtol=0)
+    assert r0["sync_err"] == 0.0  # consensus crossed 3 process boundaries
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("need 8 devices for the single-process twin")
+    k = 8
+    src = synthetic_cifar(n_train=8 * k, n_test=2 * k)
+    cfg = get_preset(
+        "fedavg", model="net", n_clients=k, batch=4, nloop=1, nadmm=1,
+        check_results=False,
+    )
+    tr = Trainer(cfg, verbose=False, source=src)
+    gid = tr.group_order[0]
+    tr.run_round(nloop=0, gid=gid)
+    flat_sum = float(np.float64(np.asarray(tr._fetch(tr.flat)).sum()))
+    accs = [float(a) for a in tr.evaluate()]
+
+    assert gid == r0["gid"]
+    np.testing.assert_allclose(flat_sum, r0["flat_sum"], rtol=1e-6)
+    np.testing.assert_allclose(accs, r0["accs"], rtol=0)
+
+
+def test_two_process_streaming_matches_single_process_streaming():
+    # round-4 VERDICT item 8: streaming x multi-process, implemented as
+    # HOST-SHARDED streaming — each process runs PrefetchBatchers only
+    # for the clients its mesh devices own, and `_put` assembles the
+    # global chunk from per-process columns. The streams are pure
+    # functions of (seed, batch, client), so the 2-process run must
+    # reproduce a single-process streaming run's metrics exactly.
+    r0, r1 = _run_workers(2, mode="stream")
+    assert r0["gid"] == r1["gid"]
+    np.testing.assert_allclose(r0["flat_sum"], r1["flat_sum"], rtol=0)
+    np.testing.assert_allclose(r0["accs"], r1["accs"], rtol=0)
+    assert r0["sync_err"] == 0.0
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("need 8 devices for the single-process twin")
+    k = 8
+    src = synthetic_cifar(n_train=8 * k, n_test=2 * k)
+    cfg = get_preset(
+        "fedavg", model="net", n_clients=k, batch=4, nloop=1, nadmm=1,
+        check_results=False, hbm_data_budget_mb=0, stream_chunk_steps=1,
+    )
+    tr = Trainer(cfg, verbose=False, source=src)
+    assert tr._stream and len(tr._batchers) == k  # all clients local here
+    gid = tr.group_order[0]
+    tr.run_round(nloop=0, gid=gid)
+    flat_sum = float(np.float64(np.asarray(tr._fetch(tr.flat)).sum()))
+    accs = [float(a) for a in tr.evaluate()]
+    for b in tr._batchers.values():
+        b.close()
 
     assert gid == r0["gid"]
     np.testing.assert_allclose(flat_sum, r0["flat_sum"], rtol=1e-6)
