@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # One-command convergence-parity suite: torch reference (imported
-# LBFGSNew) vs this framework on identical data, all four configurations,
+# LBFGSNew) vs this framework on identical data, all five configurations,
 # followed by a hard band check (exit 1 if ANY tolerance band fails).
 #
 #   scripts/parity_suite.sh                  # discriminating synthetic
@@ -15,11 +15,14 @@
 # resnet configs are hours — run the suite detached.
 #
 # Knobs: PARITY_NLOOP (simple configs), PARITY_RESNET_NLOOP /
-# PARITY_RESNET_NTRAIN (resnet configs), PARITY_RHO0.
+# PARITY_RESNET_NTRAIN (resnet configs), PARITY_MATCHED_NTRAIN (the
+# matched-dynamics config; pinned to its measured 256 default),
+# PARITY_RHO0.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-for cfg in fedavg_simple admm_simple fedavg_resnet admm_resnet; do
+for cfg in fedavg_simple admm_simple fedavg_resnet admm_resnet \
+           fedavg_resnet_matched; do
   echo "=== convergence_parity: ${cfg} ==="
   python benchmarks/convergence_parity.py "${cfg}"
 done
@@ -46,7 +49,21 @@ for name, r in sorted(d.items()):
                  "dual_within_half_order", "primal_within_half_order",
                  "rho_ratio_within_2x")
     similar = v.get("final_acc_diff", 1.0) <= v.get("acc_band", 0.05)
-    if similar:
+    # matched-dynamics configs carry a RECORDED flag (config.matched in
+    # the artifact — semantics attached to the config, not its name);
+    # they exist precisely to validate the residual trajectory by
+    # measurement, so similarity and the bands are REQUIRED, never
+    # waived, and the residual band must be PRESENT: a run that stops
+    # emitting residual data must fail, not pass by omission.
+    if r.get("config", {}).get("matched") or name.endswith("_matched"):
+        if not similar:
+            fails.append("matched_config_no_longer_similar")
+        required = ["dual_within_half_order"]
+        if r.get("config", {}).get("strategy") == "admm":
+            required += ["primal_within_half_order", "rho_ratio_within_2x"]
+        fails += [f"missing:{k}" for k in required if k not in v]
+        fails += [k for k in BAND_KEYS if k in v and not v[k]]
+    elif similar:
         fails += [k for k in BAND_KEYS if k in v and not v[k]]
     beats = " (framework beats reference)" if v.get(
         "framework_beats_reference") and not similar else ""

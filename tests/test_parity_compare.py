@@ -70,3 +70,19 @@ def test_within_band_parity_passes_all_primary_criteria():
     assert v["both_above_2x_chance"]
     assert v["acc_final_within_band"]
     assert v["primary_pass"]
+
+
+def test_chance_floor_scales_with_num_classes():
+    # ADVICE r4: a 100-class config must clear 2x its own 0.01 chance,
+    # not inherit the 10-class 0.2 bar (and vice versa: 0.12 acc is a
+    # meaningful pass at 100 classes, a near-chance fail at 10)
+    compare = _load_compare()
+    fw = {"acc": [[0.02], [0.12]], "dual": [1e-3], "primal": [],
+          "mean_rho": []}
+    ref = {"acc": [[0.02], [0.12]], "dual": [1e-3], "primal": [],
+           "mean_rho": []}
+    v10 = compare(fw, ref, "fedavg", num_classes=10)
+    v100 = compare(fw, ref, "fedavg", num_classes=100)
+    assert not v10["both_above_2x_chance"] and not v10["primary_pass"]
+    assert v100["both_above_2x_chance"] and v100["primary_pass"]
+    assert v100["num_classes"] == 100
