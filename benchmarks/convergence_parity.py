@@ -452,7 +452,8 @@ def _log_ratio_band(fw, ref):
     )
 
 
-def compare(fw, ref, strategy, acc_band=0.05, num_classes=10):
+def compare(fw, ref, strategy, acc_band=0.05, num_classes=10,
+            matched=False):
     """`acc_band` is the final-accuracy tolerance: all four configs run
     their FULL schedule until both sides sit well above chance, where a
     0.05 band on the plateau is a meaningful oracle (a wrong consensus
@@ -461,6 +462,14 @@ def compare(fw, ref, strategy, acc_band=0.05, num_classes=10):
     `num_classes` sets the chance floor (1/num_classes) for the
     above-2x-chance sanity check — a 100-class config must clear 0.02,
     not inherit the 10-class 0.2 bar.
+
+    `matched=True` (matched-dynamics configs) additionally emits
+    `matched_pass`: the SINGLE source of the stricter oracle the suite
+    gate enforces for those configs — primary pass AND similar final
+    accuracy AND every trajectory band for this strategy present and
+    true (a residual series that stops being produced fails here rather
+    than passing by omission). The gate reads only this bool, never the
+    band key set.
     """
     fa, ra = _mean_curve(fw["acc"]), _mean_curve(ref["acc"])
     m = min(len(fa), len(ra))
@@ -504,6 +513,15 @@ def compare(fw, ref, strategy, acc_band=0.05, num_classes=10):
             ratio = fw["mean_rho"][-1] / ref["mean_rho"][-1]
             out["final_rho_ratio"] = round(float(ratio), 3)
             out["rho_ratio_within_2x"] = 0.5 <= ratio <= 2.0
+    if matched:
+        required = ["acc_final_within_band", "acc_mean_within_0.06",
+                    "dual_within_half_order"]
+        if strategy == "admm":
+            required += ["primal_within_half_order", "rho_ratio_within_2x"]
+        out["matched_pass"] = bool(
+            out["primary_pass"]
+            and all(out.get(k, False) for k in required)
+        )
     return out
 
 
@@ -583,7 +601,8 @@ def main():
             },
         },
         "verdict": compare(fw, ref, c["strategy"], c["acc_band"],
-                           num_classes=c.get("num_classes", 10)),
+                           num_classes=c.get("num_classes", 10),
+                           matched=c.get("matched", False)),
     }
 
     merged = {}

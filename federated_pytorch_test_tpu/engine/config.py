@@ -96,6 +96,18 @@ class ExperimentConfig:
     # is the only place running BN statistics refresh — enforced in the
     # step itself).
     diag_forward: bool = True
+    # fold the diagnostic forward into the accepted line-search
+    # evaluation (round 5): the Armijo-accepted evaluation IS at the
+    # step's final parameters and already computes the BN batch
+    # statistics the closure used to discard, so the diagnostic print +
+    # stats refresh come out of lbfgs_step's aux channel with one fewer
+    # model pass per minibatch. The PARAMETER trajectory is bit-identical
+    # either way (train-mode BN never reads running stats); running
+    # stats and the printed loss can differ from the unfolded path by
+    # XLA fusion ulps. False forces the explicit diagnostic forward
+    # (pre-round-5 bitwise telemetry; equivalence tested in
+    # tests/test_engine.py).
+    fold_diag_forward: bool = True
 
     # inner optimizer (reference src/federated_trio.py:273-275)
     lbfgs_history: int = 10

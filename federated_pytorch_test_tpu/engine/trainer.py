@@ -349,6 +349,7 @@ class Trainer:
             # the diagnostic forward is the only place running BN stats
             # refresh: models with batch stats always run it
             diag_forward=cfg.diag_forward or self.has_stats,
+            fold_diag=cfg.fold_diag_forward,
         )
 
     def _fns(self, gid: int):

@@ -45,6 +45,7 @@ from jax import lax
 
 from federated_pytorch_test_tpu.optim.compact import compact_direction
 from federated_pytorch_test_tpu.optim.linesearch import (
+    backtracking_armijo_aux,
     vma_zero,
     backtracking_armijo,
     cubic_linesearch,
@@ -123,6 +124,13 @@ class LBFGSAux(NamedTuple):
     step_size: jnp.ndarray  # last accepted step size
     n_inner: jnp.ndarray  # inner iterations executed this step
     func_evals: jnp.ndarray  # closure-equivalent evaluations this step
+    # `has_aux=True` only: the user aux of the evaluation AT THE FINAL
+    # PARAMETERS (the accepted line-search point or the re-evaluation,
+    # whichever saw final x last; () otherwise), and whether it is valid
+    # — False only on the rare NaN-step-size fallback whose final point
+    # was never evaluated (see lbfgs_step)
+    aux: Any = ()
+    aux_ok: jnp.ndarray | bool = True
 
 
 def lbfgs_init(x0: jnp.ndarray, config: LBFGSConfig) -> LBFGSState:
@@ -234,6 +242,8 @@ class _Carry(NamedTuple):
     running_avg: jnp.ndarray
     running_avg_sq: jnp.ndarray
     done: jnp.ndarray
+    aux: Any  # user aux of the last evaluation at the carry's x
+    aux_ok: jnp.ndarray  # False while x was produced by the NaN fallback
 
 
 def lbfgs_step(
@@ -241,6 +251,7 @@ def lbfgs_step(
     x: jnp.ndarray,
     state: LBFGSState,
     config: LBFGSConfig,
+    has_aux: bool = False,
 ) -> Tuple[jnp.ndarray, LBFGSState, LBFGSAux]:
     """One optimizer step: up to `max_iter` L-BFGS iterations with line search.
 
@@ -248,14 +259,35 @@ def lbfgs_step(
     over the batch before calling). The whole body — direction updates,
     history pushes, line-search probes — is jit-compatible; the equivalent
     of the reference's `step(closure)` (src/lbfgsnew.py:485-743).
+
+    With `has_aux=True`, `loss_fn` returns `(loss, aux)` and the returned
+    `LBFGSAux.aux` is the user aux of the evaluation AT THE FINAL
+    PARAMETERS — every loss evaluation already computes it, so exporting
+    it is free, and it is what lets the engine fold its per-batch
+    diagnostic forward (BN batch statistics + raw data loss) into the
+    accepted line-search evaluation instead of paying an extra model
+    pass (engine/steps.py). Only the batch-mode Armijo path threads aux
+    (the accepted alpha there is provably the last one evaluated); the
+    cubic search accepts points it probed earlier, so `has_aux` requires
+    `batch_mode` + `line_search`. `LBFGSAux.aux_ok` is False only when
+    the final x came from the NaN-step-size fallback AND was never
+    re-evaluated — callers must keep their previous aux then.
     """
+    if has_aux and not (config.batch_mode and config.line_search):
+        raise ValueError(
+            "has_aux requires batch_mode line search: only the Armijo "
+            "path's accepted step is guaranteed to be its last-evaluated "
+            "point, which is what makes the carried aux belong to the "
+            "returned parameters"
+        )
     max_eval = config.resolved_max_eval
     tol_grad = config.tolerance_grad
     tol_change = config.tolerance_change
     lr = jnp.asarray(config.lr, x.dtype)
 
-    value_and_grad = jax.value_and_grad(loss_fn)
-    loss0, g0 = value_and_grad(x)
+    loss_fn_aux = loss_fn if has_aux else (lambda xx: (loss_fn(xx), ()))
+    value_and_grad = jax.value_and_grad(loss_fn_aux, has_aux=True)
+    (loss0, aux0), g0 = value_and_grad(x)
     abs_grad_sum0 = jnp.sum(jnp.abs(g0))
     # Frozen at entry for both the loop guard and alphabar (see module
     # docstring on reproduced quirks).
@@ -363,16 +395,27 @@ def lbfgs_step(
 
         gtd = jnp.dot(c.g, d)
 
+        aux_new = c.aux
+        aux_ok_new = c.aux_ok
         if config.line_search:
             x_cur = c.x
 
-            def phi(alpha):
-                return loss_fn(x_cur + alpha * d)
+            def phi_aux(alpha):
+                return loss_fn_aux(x_cur + alpha * d)
 
             if config.batch_mode:
-                t_ls, _ = backtracking_armijo(phi, c.loss, gtd, alphabar)
+                t_ls, _, aux_ls = backtracking_armijo_aux(
+                    phi_aux, c.loss, gtd, alphabar
+                )
+                aux_new = aux_ls
+                # a NaN step size falls back to lr below: the point
+                # x + lr*d was never evaluated, so the carried aux does
+                # not belong to it (restored if the re-evaluation runs)
+                aux_ok_new = ~jnp.isnan(t_ls)
             else:
-                t_ls = cubic_linesearch(phi, c.loss, config.lr)
+                t_ls = cubic_linesearch(
+                    lambda a: phi_aux(a)[0], c.loss, config.lr
+                )
             t = jnp.where(jnp.isnan(t_ls), lr, t_ls).astype(c.x.dtype)
 
         x = c.x + t * d
@@ -387,13 +430,20 @@ def lbfgs_step(
         )
 
         def reeval(_):
-            l, gg = value_and_grad(x)
-            return l, gg, jnp.sum(jnp.abs(gg)), c.evals + 1
+            (l, aux_r), gg = value_and_grad(x)
+            # the re-evaluation IS at x, whatever step-size fallback
+            # produced it — aux becomes valid again (| True keeps
+            # aux_ok_new's varying-mesh-axis type under vma checking)
+            return l, gg, jnp.sum(jnp.abs(gg)), c.evals + 1, aux_r, (
+                aux_ok_new | True
+            )
 
         def keep(_):
-            return c.loss, c.g, c.abs_grad_sum, c.evals
+            return c.loss, c.g, c.abs_grad_sum, c.evals, aux_new, aux_ok_new
 
-        loss, g, abs_grad_sum, evals = lax.cond(stop_now, keep, reeval, None)
+        loss, g, abs_grad_sum, evals, aux_new, aux_ok_new = lax.cond(
+            stop_now, keep, reeval, None
+        )
 
         done = (
             stop_now
@@ -422,6 +472,8 @@ def lbfgs_step(
             running_avg=ravg,
             running_avg_sq=ravgsq,
             done=done,
+            aux=aux_new,
+            aux_ok=aux_ok_new,
         )
 
     # Exact zeros carrying the loss's varying-mesh-axis type. Under
@@ -452,6 +504,10 @@ def lbfgs_step(
         running_avg=state.running_avg + vz,
         running_avg_sq=state.running_avg_sq + vz,
         done=abs_grad_sum0 <= tol_grad,
+        # entry evaluation is at x: if no iteration runs, final x == x
+        # and aux0 is exactly its aux
+        aux=aux0,
+        aux_ok=vz == 0,
     )
 
     def masked_body(c: _Carry) -> _Carry:
@@ -488,5 +544,7 @@ def lbfgs_step(
         step_size=final.t,
         n_inner=final.n_inner,
         func_evals=final.evals,
+        aux=final.aux,
+        aux_ok=final.aux_ok,
     )
     return final.x, new_state, aux

@@ -56,21 +56,31 @@ def _freeze(pred, new, old):
     return jax.tree.map(lambda n, o: jnp.where(pred, o, n), new, old)
 
 
-def backtracking_armijo(
-    phi: PhiFn,
+def backtracking_armijo_aux(
+    phi_aux,
     f_old: Scalar,
     gtd: Scalar,
     alphabar: Scalar,
     c1: float = 1e-4,
     max_iters: int = 35,
-) -> Tuple[Scalar, Scalar]:
-    """Armijo backtracking from max step `alphabar`.
+):
+    """Armijo backtracking from max step `alphabar`, carrying eval aux.
 
     Reference src/lbfgsnew.py:124-174: start at `alphabar`, halve while
     `f(x + a d) > f_old + a * c1 * g.d`, up to `max_iters` halvings; the
     last step is returned even if the condition never held.
 
-    Returns `(alpha, n_evals)`.
+    `phi_aux(alpha) -> (loss, aux)`. The loop carries the aux of the
+    LAST evaluated alpha, and that alpha IS the accepted one (the loop
+    exits when the current pair satisfies the condition or exhausts the
+    budget, and the vmap freeze keeps (alpha, loss, aux) triples
+    consistent) — so the returned aux belongs to the returned step.
+    This is what lets the engine fold its per-batch diagnostic forward
+    into the accepted evaluation: `aux` carries the BN batch statistics
+    and the raw data loss that the forward at the accepted point already
+    computed (engine/steps.py).
+
+    Returns `(alpha, n_evals, aux)`.
 
     vmap-safe: under `jax.vmap` a `while_loop` body runs for every batch
     element while ANY element's condition holds, so the halving is masked
@@ -80,22 +90,40 @@ def backtracking_armijo(
     prod = c1 * gtd
 
     def cond(carry):
-        ci, alpha, f_new = carry
+        ci, alpha, f_new, _ = carry
         return jnp.logical_and(ci < max_iters, f_new > f_old + alpha * prod)
 
     def body(carry):
-        ci, alpha, f_new = carry
+        ci, alpha, f_new, aux = carry
         active = (f_new > f_old + alpha * prod) & (ci < max_iters)
         alpha_half = 0.5 * alpha
-        return _freeze(~active, (ci + 1, alpha_half, phi(alpha_half)), carry)
+        f_half, aux_half = phi_aux(alpha_half)
+        return _freeze(
+            ~active, (ci + 1, alpha_half, f_half, aux_half), carry
+        )
 
-    f1 = phi(alphabar)
+    f1, aux1 = phi_aux(alphabar)
     vz = vma_zero(f_old)
     iz = vz.astype(jnp.int32)
-    ci, alpha, _ = lax.while_loop(
-        cond, body, (jnp.int32(0) + iz, alphabar + vz, f1 + vz)
+    ci, alpha, _, aux = lax.while_loop(
+        cond, body, (jnp.int32(0) + iz, alphabar + vz, f1 + vz, aux1)
     )
-    return alpha, ci + 1
+    return alpha, ci + 1, aux
+
+
+def backtracking_armijo(
+    phi: PhiFn,
+    f_old: Scalar,
+    gtd: Scalar,
+    alphabar: Scalar,
+    c1: float = 1e-4,
+    max_iters: int = 35,
+) -> Tuple[Scalar, Scalar]:
+    """`backtracking_armijo_aux` without an aux payload; same contract."""
+    alpha, evals, _ = backtracking_armijo_aux(
+        lambda a: (phi(a), ()), f_old, gtd, alphabar, c1, max_iters
+    )
+    return alpha, evals
 
 
 class _CubicConsts(NamedTuple):

@@ -52,17 +52,13 @@ for name, r in sorted(d.items()):
     # matched-dynamics configs carry a RECORDED flag (config.matched in
     # the artifact — semantics attached to the config, not its name);
     # they exist precisely to validate the residual trajectory by
-    # measurement, so similarity and the bands are REQUIRED, never
-    # waived, and the residual band must be PRESENT: a run that stops
-    # emitting residual data must fail, not pass by omission.
+    # measurement, so compare() emits their stricter oracle as ONE bool
+    # (`matched_pass`: primary + similar + every strategy band present
+    # and true — unit-tested in tests/test_parity_compare.py) and this
+    # gate reads only that, mirroring no key set.
     if r.get("config", {}).get("matched") or name.endswith("_matched"):
-        if not similar:
-            fails.append("matched_config_no_longer_similar")
-        required = ["dual_within_half_order"]
-        if r.get("config", {}).get("strategy") == "admm":
-            required += ["primal_within_half_order", "rho_ratio_within_2x"]
-        fails += [f"missing:{k}" for k in required if k not in v]
-        fails += [k for k in BAND_KEYS if k in v and not v[k]]
+        if not v.get("matched_pass", False):
+            fails.append("matched_pass")
     elif similar:
         fails += [k for k in BAND_KEYS if k in v and not v[k]]
     beats = " (framework beats reference)" if v.get(

@@ -754,3 +754,70 @@ def test_compile_round_seeds_cache_without_training():
     twin = Trainer(cfg, verbose=False, source=SRC)
     twin.run_round(nloop=0, gid=gid)
     np.testing.assert_array_equal(np.asarray(tr.flat), np.asarray(twin.flat))
+
+
+def test_folded_diag_forward_matches_explicit():
+    # round-5 fold: the Armijo-accepted evaluation IS at the step's
+    # final params, so threading its (data loss, BN stats) out of
+    # lbfgs_step replaces the explicit diagnostic forward. Parameters
+    # must be BIT-identical (train-mode BN never reads running stats);
+    # running stats and the loss telemetry agree to XLA-fusion ulps.
+    # One jitted client step on one minibatch (a double Trainer.run on
+    # resnet costs ~10 min of compiles on the 1-core CI host; the fold
+    # lives entirely inside _client_train_step, so one call covers it).
+    import jax
+    import jax.numpy as jnp
+
+    from federated_pytorch_test_tpu.engine.steps import _client_train_step
+
+    src = synthetic_cifar(n_train=48, n_test=12)
+    cfg = tiny("fedavg_resnet", model="resnet18", batch=16,
+               synthetic_n_train=48, synthetic_n_test=12)
+    tr = Trainer(cfg, verbose=False, source=src)
+    gid = tr.group_order[0]
+    _, _, init_fn = tr._fns(gid)
+    lstate_k, y_k, z, rho_k, _ = init_fn(tr.flat)
+    one = lambda t: jax.tree.map(lambda x: jnp.asarray(np.asarray(x)[0]), t)
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(0, 256, size=(16, 32, 32, 3)), jnp.uint8)
+    labels = jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32)
+    args = (one(tr.flat), one(lstate_k), one(tr.stats), imgs, labels,
+            one(tr.mean), one(tr.std), one(y_k), jnp.asarray(z), one(rho_k))
+
+    outs = {}
+    for fold in (True, False):
+        ctx = tr._ctx(gid)._replace(fold_diag=fold)
+        outs[fold] = jax.jit(_client_train_step(ctx))(*args)
+    flat_f, _, stats_f, loss_f = outs[True]
+    flat_e, _, stats_e, loss_e = outs[False]
+    np.testing.assert_array_equal(np.asarray(flat_f), np.asarray(flat_e))
+    for a, b in zip(jax.tree.leaves(stats_f), jax.tree.leaves(stats_e)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+    np.testing.assert_allclose(
+        float(loss_f), float(loss_e), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_folded_diag_forward_matches_explicit_bnless_and_admm():
+    # BN-less model + ADMM penalties: the folded data-loss telemetry
+    # must equal the explicit diagnostic forward's (penalty-free) loss
+    src = synthetic_cifar(n_train=120, n_test=24)
+    base = tiny("admm", model="net", batch=24, nadmm=2,
+                synthetic_n_train=120, synthetic_n_test=24)
+    runs = {}
+    for fold in (True, False):
+        tr = Trainer(base.replace(fold_diag_forward=fold), verbose=False,
+                     source=src)
+        tr.group_order = tr.group_order[:1]
+        rec = tr.run()
+        runs[fold] = (
+            np.asarray(tr.flat).copy(),
+            [r["value"] for r in rec.series["train_loss"]],
+        )
+    np.testing.assert_array_equal(runs[True][0], runs[False][0])
+    np.testing.assert_allclose(
+        np.asarray(runs[True][1]), np.asarray(runs[False][1]),
+        rtol=1e-5, atol=1e-6,
+    )

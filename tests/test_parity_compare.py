@@ -86,3 +86,35 @@ def test_chance_floor_scales_with_num_classes():
     assert not v10["both_above_2x_chance"] and not v10["primary_pass"]
     assert v100["both_above_2x_chance"] and v100["primary_pass"]
     assert v100["num_classes"] == 100
+
+
+def test_matched_pass_requires_present_and_true_bands():
+    # matched-dynamics oracle: one bool the gate reads (never the key
+    # set). All bands present+true -> pass; a MISSING residual series
+    # (dual curve empty -> dual_log10_median None, band key absent) must
+    # FAIL, not pass by omission; a dissimilar final accuracy fails too.
+    compare = _load_compare()
+
+    def mk(dual):
+        return {"acc": [[0.1], [0.5]], "dual": dual, "primal": [],
+                "mean_rho": []}
+
+    ok = compare(mk([1e-3]), mk([1.1e-3]), "fedavg", matched=True)
+    assert ok["matched_pass"]
+
+    missing = compare(mk([]), mk([]), "fedavg", matched=True)
+    assert "dual_within_half_order" not in missing
+    assert not missing["matched_pass"]
+
+    off_band = compare(mk([1e-1]), mk([1e-3]), "fedavg", matched=True)
+    assert not off_band["matched_pass"]
+
+    fw = {"acc": [[0.1], [0.55]], "dual": [1e-3], "primal": [],
+          "mean_rho": []}
+    rf = {"acc": [[0.1], [0.30]], "dual": [1e-3], "primal": [],
+          "mean_rho": []}
+    dissimilar = compare(fw, rf, "fedavg", matched=True)
+    assert dissimilar["primary_pass"] and not dissimilar["matched_pass"]
+
+    # non-matched calls never emit the key
+    assert "matched_pass" not in compare(mk([1e-3]), mk([1e-3]), "fedavg")
