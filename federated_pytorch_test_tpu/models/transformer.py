@@ -75,20 +75,29 @@ class MultiHeadAttention(nn.Module):
             )
         b, s, _ = x.shape
         impl = self.attn_impl
+        # one effective precision for BOTH the auto crossover and the
+        # flash kernel call, validated up front: a non-canonical value
+        # must raise here, not silently pick the conservative crossover
+        # (flash_attention would only validate it when flash is chosen)
+        prec = self.attn_precision or "highest"
+        if prec not in ("highest", "default"):
+            raise ValueError(
+                f"attn_precision must be None, 'highest' or 'default', "
+                f"got {self.attn_precision!r}"
+            )
         if impl == "auto":
-            # measured single-chip crossover (benchmarks/
-            # long_context_tpu.json, flash_f32_tiles.json): the flash
-            # kernels beat dense XLA attention solidly from S>=2048
-            # (2.8x 'default', 1.05-1.35x full-f32). At S=1024 the two
-            # measurements straddle parity ('default': 1.17x round 2,
-            # 0.94x round 3 — within shared-chip noise) and full-f32
-            # loses with every tile shape (9 swept), so below 2048
-            # dense's fused [S,S] path is the safe pick and its score
-            # memory is affordable. S is static under jit, so this
-            # resolves at trace time.
+            # measured single-chip crossover, round-5 kernels + the
+            # floor-subtracted v2 protocol (benchmarks/
+            # long_context_tpu.json, flash_f32_tiles.json): at 'default'
+            # precision flash beats dense 1.55x already at S=1024 (4.6x
+            # at S=2048); at 'highest' S=1024 still belongs to dense
+            # (0.72x) and flash wins from S=2048 (1.27-1.29x). The
+            # threshold is therefore precision-dependent. S is static
+            # under jit, so this resolves at trace time.
             # (the flash kernels also need S % 128 == 0 — ragged lengths
             # always take dense, whatever their size)
-            impl = "flash" if s >= 2048 and s % 128 == 0 else "dense"
+            crossover = 1024 if prec == "default" else 2048
+            impl = "flash" if s >= crossover and s % 128 == 0 else "dense"
         h, hd = self.num_heads, self.dim // self.num_heads
         qkv = nn.Dense(
             3 * self.dim, name="qkv", kernel_init=kernel_init,
@@ -121,8 +130,7 @@ class MultiHeadAttention(nn.Module):
             )
 
             out = flash_attention(
-                q, k, v, causal=self.causal,
-                precision=self.attn_precision or "highest",
+                q, k, v, causal=self.causal, precision=prec,
             )
         else:
             out = dense_attention(q, k, v, causal=self.causal)
