@@ -41,7 +41,7 @@ if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from federated_pytorch_test_tpu.parallel import shard_map
 from jax.sharding import PartitionSpec as P
 
 from federated_pytorch_test_tpu.consensus import FedAvgState, fedavg_round

@@ -88,7 +88,8 @@ def main():
         ).sum()
         return jax.lax.psum(loss, SEQ_AXIS) / SEQ  # global mean
 
-    sharded = jax.shard_map(
+    from federated_pytorch_test_tpu.parallel import shard_map
+    sharded = shard_map(
         shard_loss,
         mesh=mesh,
         in_specs=(P(), P(None, SEQ_AXIS), P(None, SEQ_AXIS)),

@@ -22,6 +22,7 @@ Layout:
   optim/      jittable stochastic L-BFGS (two-loop recursion + line searches)
   consensus/  FedAvg / ADMM / adaptive-rho strategies as pure collective fns
   parallel/   mesh construction, client-axis collectives, sharded step builders
+  fault/      replayable failure injection: dropout masks, stragglers, crashes
   ops/        numerics kernels (Pallas where warranted)
   utils/      config presets, metrics, checkpointing, tracing
 """

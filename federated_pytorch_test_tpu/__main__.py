@@ -8,6 +8,13 @@ scripts are presets and every constant is a flag:
     python -m federated_pytorch_test_tpu --preset fedavg
     python -m federated_pytorch_test_tpu --preset admm --nloop 2 --no-bb-update
     python -m federated_pytorch_test_tpu --list-presets
+
+Chaos runs (fault/, docs/FAULT.md) ride the same config surface:
+`--fault-plan "seed=1,dropout=0.3,crash=0:1:2"` (or a FaultPlan JSON
+path) injects replayable dropout/straggler/crash faults, and
+`--resume auto --save-model` makes a crashed run recover from the latest
+readable checkpoint on restart. An injected crash exits non-zero with
+the InjectedCrash message; rerunning the identical command resumes.
 """
 
 from __future__ import annotations

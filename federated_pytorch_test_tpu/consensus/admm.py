@@ -32,7 +32,7 @@ psum, so the whole round is one SPMD function over the local client block.
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -136,19 +136,41 @@ class ADMMMetrics(NamedTuple):
     primal_residual: jnp.ndarray
     dual_residual: jnp.ndarray
     mean_rho: jnp.ndarray
+    survivors: jnp.ndarray
 
 
 def admm_round(
-    x_local: jnp.ndarray, state: ADMMState, nadmm: jnp.ndarray, config: ADMMConfig
+    x_local: jnp.ndarray,
+    state: ADMMState,
+    nadmm: jnp.ndarray,
+    config: ADMMConfig,
+    mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[ADMMState, ADMMMetrics]:
     """BB adaptation (if due) + z-update + y-update for one ADMM iteration.
 
     `x_local` is the local client block `[K_loc, N]` after the x-update
     (the inner L-BFGS round); `nadmm` is the (traced) ADMM iteration index
     within the current partition round.
+
+    `mask` is the `[K_loc]` participation vector (1 = this client's
+    x-update arrived, 0 = dropped; fault/plan.py). A dropped client's
+    contribution is excluded from the z-update's weighted psum, its dual
+    y and BB carry stores (rho, x0, yhat0) are frozen — its x never
+    arrived, so adapting against it would adapt against stale state — and
+    the primal residual averages over survivors only. A degenerate
+    all-dropped round keeps z (and every y) unchanged. With the all-ones
+    mask every select picks the unmasked operand and every product is a
+    multiplication by 1.0, so the result is BIT-IDENTICAL to the unmasked
+    path (tests/test_fault.py).
     """
     n = x_local.shape[-1]
     k = client_count(x_local)
+    if mask is None:
+        part = None
+        survivors = k
+    else:
+        part = mask.astype(x_local.dtype)[:, None] > 0  # [K_loc, 1] bool
+        survivors = client_sum(mask.astype(x_local.dtype))
 
     if config.bb_update:
         is_first = nadmm == 0
@@ -157,24 +179,48 @@ def admm_round(
         rho_prop = jax.vmap(_bb_new_rho, in_axes=(0, 0, 0, 0, 0, None))(
             state.rho, yhat, state.yhat0, x_local, state.x0, config
         )
-        rho = jnp.where(due, rho_prop, state.rho)
-        x0 = jnp.where(is_first | due, x_local, state.x0)
-        yhat0 = jnp.where(due, yhat, state.yhat0)
+        if part is not None:
+            due_k = due & part  # dropped clients freeze their BB state
+            first_k = is_first | due_k
+        else:
+            due_k, first_k = due, is_first | due
+        rho = jnp.where(due_k, rho_prop, state.rho)
+        x0 = jnp.where(first_k, x_local, state.x0)
+        yhat0 = jnp.where(due_k, yhat, state.yhat0)
     else:
         rho, x0, yhat0 = state.rho, state.x0, state.yhat0
 
     # z-update: weighted mean with v = y/rho + x, w = rho so that
-    # sum(v*w)/sum(w) == sum(y + rho*x)/sum(rho) (reference :502)
-    znew = weighted_client_mean(state.y / rho + x_local, rho)
+    # sum(v*w)/sum(w) == sum(y + rho*x)/sum(rho) (reference :502); under a
+    # mask the weight becomes rho*m — surviving clients only
+    if part is None:
+        znew = weighted_client_mean(state.y / rho + x_local, rho)
+    else:
+        w = rho * part.astype(x_local.dtype)
+        num = client_sum((state.y / rho + x_local) * w)
+        den = client_sum(w)
+        znew = num / jnp.where(den > 0, den, 1.0)
     if config.z_soft_threshold > 0.0:
         znew = soft_threshold(znew, config.z_soft_threshold)
+    if part is not None:
+        znew = jnp.where(survivors > 0, znew, state.z)
     dual = jnp.linalg.norm(state.z - znew) / n
 
-    # y-update (reference :511-513)
-    y = state.y + rho * (x_local - znew)
+    # y-update (reference :511-513); dropped clients keep their duals —
+    # they neither saw znew nor contributed an x
+    if part is None:
+        y = state.y + rho * (x_local - znew)
+    else:
+        y = jnp.where(part, state.y + rho * (x_local - znew), state.y)
 
-    primal = client_sum(jnp.linalg.norm(x_local - znew, axis=-1)) / (k * n)
+    if part is None:
+        primal = client_sum(jnp.linalg.norm(x_local - znew, axis=-1)) / (k * n)
+    else:
+        resid = jnp.linalg.norm(x_local - znew, axis=-1)
+        primal = client_sum(mask.astype(x_local.dtype) * resid) / (
+            jnp.where(survivors > 0, survivors, 1.0) * n
+        )
     mean_rho = client_sum(jnp.sum(rho, axis=-1)) / k
 
     new_state = ADMMState(y=y, z=znew, rho=rho, yhat0=yhat0, x0=x0)
-    return new_state, ADMMMetrics(primal, dual, mean_rho)
+    return new_state, ADMMMetrics(primal, dual, mean_rho, survivors)

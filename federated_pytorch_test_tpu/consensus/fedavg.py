@@ -14,12 +14,12 @@ SPMD form: `fedavg_round` runs inside `shard_map`; the average is one
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 
 from federated_pytorch_test_tpu.consensus.penalties import soft_threshold
-from federated_pytorch_test_tpu.parallel import client_mean
+from federated_pytorch_test_tpu.parallel import client_count, client_mean, client_sum
 
 
 class FedAvgState(NamedTuple):
@@ -32,7 +32,10 @@ def fedavg_init(n: int, dtype=jnp.float32) -> FedAvgState:
 
 
 def fedavg_round(
-    x_local: jnp.ndarray, state: FedAvgState, z_soft_threshold: float = 0.0
+    x_local: jnp.ndarray,
+    state: FedAvgState,
+    z_soft_threshold: float = 0.0,
+    mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[FedAvgState, dict]:
     """One averaging round over the local client block `[K_loc, N]`.
 
@@ -42,10 +45,27 @@ def fedavg_round(
     `z_soft_threshold > 0` applies the elastic-net proximal soft shrinkage
     to znew — the reference ships this disabled but keeps the helper
     (reference src/federated_trio.py:188-196).
+
+    `mask` is the `[K_loc]` participation vector of the local client block
+    (1 = the client's contribution arrived this round, 0 = dropped; see
+    fault/plan.py): the mean is mask-weighted over surviving clients only.
+    A degenerate all-dropped round keeps the previous consensus state and
+    reports `survivors == 0`. With the all-ones mask every operation is
+    multiplication by 1.0 and division by the identical psum'd K, so the
+    result is BIT-IDENTICAL to the unmasked path (tests/test_fault.py).
     """
     n = x_local.shape[-1]
-    znew = client_mean(x_local)
+    if mask is None:
+        znew = client_mean(x_local)
+        survivors = client_count(x_local)
+    else:
+        m = mask.astype(x_local.dtype)
+        survivors = client_sum(m)
+        safe = jnp.where(survivors > 0, survivors, 1.0)
+        znew = client_sum(x_local * m[:, None]) / safe
     if z_soft_threshold > 0.0:
         znew = soft_threshold(znew, z_soft_threshold)
+    if mask is not None:
+        znew = jnp.where(survivors > 0, znew, state.z)
     dual = jnp.linalg.norm(state.z - znew) / n
-    return FedAvgState(z=znew), {"dual_residual": dual}
+    return FedAvgState(z=znew), {"dual_residual": dual, "survivors": survivors}

@@ -160,8 +160,26 @@ class ExperimentConfig:
     # per-client losses each epoch and per-client parameter finiteness
     # each consensus round. 'warn' records a `fault` metric and continues
     # (the optimizer's NaN guards already freeze a poisoned client);
-    # 'raise' aborts the run; 'off' skips the checks.
+    # 'raise' aborts the run; 'rollback' restores the pre-round snapshot
+    # of a partition round whose losses/params went NaN/Inf and moves on
+    # (docs/FAULT.md — the round is sacrificed, the run survives);
+    # 'off' skips the checks.
     fault_mode: str = "warn"
+
+    # failure INJECTION (fault/plan.py): a path to a FaultPlan JSON file
+    # or an inline spec like "seed=1,dropout=0.3,crash=0:1:2". Dropped
+    # clients are excluded from consensus via the participation mask,
+    # stragglers stall the round host-side, and crash points raise
+    # InjectedCrash at the named round boundary (recover with
+    # resume='auto'). None = no chaos; every fault is a pure function of
+    # (plan seed, round cursor), so chaos runs replay exactly.
+    fault_plan: str | None = None
+
+    # 'auto': restore the latest READABLE checkpoint under checkpoint_dir
+    # if one exists, else start fresh — the crash-recovery switch a chaos
+    # run restarts with (load_model instead *requires* a checkpoint).
+    # 'off': only load_model controls restoring.
+    resume: str = "off"
 
     # flags (reference src/federated_trio.py:28-31)
     init_model: bool = True  # common-seed init across clients
@@ -198,10 +216,14 @@ class ExperimentConfig:
                 f"compute_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.compute_dtype!r}"
             )
-        if self.fault_mode not in ("warn", "raise", "off"):
+        if self.fault_mode not in ("warn", "raise", "rollback", "off"):
             raise ValueError(
-                f"fault_mode must be 'warn', 'raise' or 'off', "
+                f"fault_mode must be 'warn', 'raise', 'rollback' or 'off', "
                 f"got {self.fault_mode!r}"
+            )
+        if self.resume not in ("off", "auto"):
+            raise ValueError(
+                f"resume must be 'off' or 'auto', got {self.resume!r}"
             )
         if self.strategy not in ("none", "fedavg", "admm"):
             raise ValueError(

@@ -34,8 +34,10 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from federated_pytorch_test_tpu.parallel.shardmap import shard_map
 
 from federated_pytorch_test_tpu.consensus import (
     ADMMConfig,
@@ -432,38 +434,51 @@ def build_consensus_fn(ctx: GroupContext, mesh):
     (reference src/federated_trio.py:353-363). ADMM: BB-rho (if due),
     weighted z-update, y-update; clients keep their own x (reference
     src/consensus_admm_trio.py:395-513).
+
+    `mask` is the `[K]` participation vector of the round (fault/plan.py;
+    all-ones when no fault plan is active — bit-identical to the unmasked
+    math). FedAvg's broadcast-back honors it too: a dropped client missed
+    the round, so it keeps its own x instead of receiving znew and rejoins
+    from stale parameters — the partial-participation regime of TAMUNA
+    (arXiv:2302.09832). Metrics gain the psum'd survivor count.
     """
     if ctx.strategy == "none":
         return None
 
     if ctx.strategy == "fedavg":
 
-        def local(flat, y, z, rho, extra, nadmm):
+        def local(flat, y, z, rho, extra, nadmm, mask):
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
             state, met = fedavg_round(
-                x, FedAvgState(z=z), ctx.admm.z_soft_threshold
+                x, FedAvgState(z=z), ctx.admm.z_soft_threshold, mask=mask
             )
             flat = jax.vmap(
-                lambda f: ctx.partition.insert(f, ctx.gid, state.z)
-            )(flat)
+                lambda f, mk: ctx.partition.insert(
+                    f,
+                    ctx.gid,
+                    jnp.where(mk > 0, state.z, ctx.partition.extract(f, ctx.gid)),
+                )
+            )(flat, mask)
             zeros = jnp.zeros((), x.dtype)
             return flat, y, state.z, rho, extra, (
                 met["dual_residual"],
                 zeros,
                 zeros,
+                met["survivors"],
             )
 
     else:  # admm
 
-        def local(flat, y, z, rho, extra, nadmm):
+        def local(flat, y, z, rho, extra, nadmm, mask):
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
             yhat0, x0 = extra
             state = ADMMState(y=y, z=z, rho=rho, yhat0=yhat0, x0=x0)
-            state, met = admm_round(x, state, nadmm, ctx.admm)
+            state, met = admm_round(x, state, nadmm, ctx.admm, mask=mask)
             return flat, state.y, state.z, state.rho, (state.yhat0, state.x0), (
                 met.dual_residual,
                 met.primal_residual,
                 met.mean_rho,
+                met.survivors,
             )
 
     c = P(CLIENT_AXIS)
@@ -471,8 +486,8 @@ def build_consensus_fn(ctx: GroupContext, mesh):
     sharded = shard_map(
         local,
         mesh=mesh,
-        in_specs=(c, c, r, c, (c, c), r),
-        out_specs=(c, c, r, c, (c, c), (r, r, r)),
+        in_specs=(c, c, r, c, (c, c), r, c),
+        out_specs=(c, c, r, c, (c, c), (r, r, r, r)),
         check_vma=True,
     )
     # no donation here: the round-init placeholders alias buffers (e.g.

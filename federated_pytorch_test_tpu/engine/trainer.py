@@ -29,6 +29,7 @@ from federated_pytorch_test_tpu.engine.steps import (
     build_round_init_fn,
     build_stream_epoch_fn,
 )
+from federated_pytorch_test_tpu.fault import FaultInjector, FaultPlan
 from federated_pytorch_test_tpu.models import MODELS
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -46,11 +47,22 @@ from federated_pytorch_test_tpu.partition import (
 )
 from federated_pytorch_test_tpu.utils import (
     MetricsRecorder,
+    checkpoint_path,
     load_checkpoint,
     save_checkpoint,
 )
 
 PyTree = Any
+
+# On-device materialization for host arrays that will later be DONATED.
+# jax's CPU device_put can be ZERO-COPY: the device buffer aliases the
+# source numpy memory. Donating such a buffer (the epoch fn donates
+# flat/lstate/stats) lets XLA reuse memory whose lifetime is tied to a
+# host array that may already be freed — observed as flaky garbage in
+# the first shard of a restored `flat` (tests/test_fault.py crash-resume
+# replay). One jitted copy allocates an XLA-owned buffer; module-level so
+# the executable is cached across Trainer instances.
+_owned_copy = jax.jit(jnp.copy)
 
 
 def _epoch_seed(base: int, *parts: int) -> np.random.Generator:
@@ -246,6 +258,8 @@ class Trainer:
         self._health_fn = None
         self._completed_nloops = 0
         self._step_num = 0
+        self._round_poisoned = False  # set by the fault checks in
+        # rollback mode; consumed at each partition-round boundary
         # per-(group, client) ADMM penalty, PERSISTENT across outer loops:
         # the reference allocates rho=[L,K]*rho0 once outside both loops
         # (reference src/consensus_admm_trio.py:263), so BB adaptations for
@@ -253,8 +267,31 @@ class Trainer:
         # round (reference :281-302) and are not stored
         self._rho_store: Dict[int, Any] = {}
 
-        if cfg.load_model:
-            self._restore()
+        # fault injection (fault/): replayable chaos — per-round dropout
+        # masks, straggler stalls, planned crash points. The all-ones mask
+        # is the no-chaos default and is BIT-identical to the pre-mask
+        # consensus math (consensus/fedavg.py, consensus/admm.py).
+        self.injector = None
+        if cfg.fault_plan:
+            self.injector = FaultInjector(
+                FaultPlan.parse(cfg.fault_plan),
+                cfg.n_clients,
+                # crash sentinels live with the checkpoints they recover
+                # from; without checkpointing the record is process-local
+                state_dir=cfg.checkpoint_dir if cfg.save_model else None,
+            )
+        self._full_mask = _put(
+            np.ones(cfg.n_clients, np.float32), csh
+        )
+
+        if cfg.load_model or cfg.resume == "auto":
+            try:
+                self._restore()
+            except FileNotFoundError:
+                if cfg.load_model:
+                    raise  # load_model REQUIRES a checkpoint; resume=auto
+                    # starts fresh when none exists (first run of a chaos
+                    # experiment, or every checkpoint was torn)
         if cfg.average_model:
             # one-shot whole-model average before training
             # (reference src/no_consensus_trio.py:22,134-160)
@@ -272,12 +309,12 @@ class Trainer:
                 )
             else:
                 host_flat = self._fetch(self.flat)
-                self.flat = self._put(
+                self.flat = _owned_copy(self._put(
                     np.broadcast_to(
                         host_flat.mean(axis=0), host_flat.shape
                     ).copy(),
                     csh,
-                )
+                ))
 
     # ---------------------------------------------------------------- setup
 
@@ -427,6 +464,7 @@ class Trainer:
                 raise FloatingPointError(
                     f"non-finite training loss on clients {bad.tolist()} ({ctx})"
                 )
+            self._round_poisoned = True
 
     def _check_params(self, **ctx) -> None:
         """Per-round failure detection: per-client parameter finiteness."""
@@ -442,6 +480,7 @@ class Trainer:
                 raise FloatingPointError(
                     f"non-finite parameters on clients {bad.tolist()} ({ctx})"
                 )
+            self._round_poisoned = True
 
     def _local_clients(self) -> list:
         """Global client ids whose mesh devices belong to THIS process.
@@ -588,14 +627,36 @@ class Trainer:
             ).compile()
         if consensus_fn is not None:
             consensus_fn.lower(
-                self.flat, y, z, rho, extra, jnp.int32(0)
+                self.flat, y, z, rho, extra, jnp.int32(0), self._full_mask
             ).compile()
         return time.perf_counter() - t0
 
     def run_round(self, nloop: int, gid: int) -> None:
-        """One partition group's full round: init, Nadmm x (epochs + consensus)."""
+        """One partition group's full round: init, Nadmm x (epochs + consensus).
+
+        With `fault_mode='rollback'` the round is transactional: a host
+        snapshot of (params, stats, rho) is taken on entry and restored if
+        any epoch loss or post-consensus parameter goes NaN/Inf — the
+        poisoned round is discarded wholesale and the run continues from
+        its entry state (docs/FAULT.md).
+        """
         cfg = self.cfg
         check = cfg.fault_mode != "off"
+        rollback = cfg.fault_mode == "rollback"
+        if rollback:
+            # DEVICE copies: the epoch fn donates flat/stats, so holding
+            # the same arrays across the round would read donated buffers
+            # — but a fresh XLA-owned copy (never handed to the epoch fn)
+            # survives donation, with no device->host round-trip (and no
+            # cross-host allgather on multi-process meshes)
+            snap_flat = _owned_copy(self.flat)
+            snap_stats = jax.tree.map(_owned_copy, self.stats)
+            snap_rho = (
+                _owned_copy(self._rho_store[gid])
+                if gid in self._rho_store
+                else None
+            )
+        self._round_poisoned = False
         epoch_fn, consensus_fn, init_fn = self._fns(gid)
         lstate, y, z, rho, extra = init_fn(self.flat)
         if cfg.strategy == "admm" and gid in self._rho_store:
@@ -690,12 +751,34 @@ class Trainer:
                         self.evaluate(), nloop=nloop, group=gid, nadmm=epoch
                     )
             if consensus_fn is not None:
+                mask = self._full_mask
+                if self.injector is not None:
+                    m_np = self.injector.mask(nloop, gid, nadmm)
+                    delay = self.injector.straggler_delay(nloop, gid, nadmm)
+                    if delay > 0:
+                        # the coordinator waiting out a slow client before
+                        # declaring the round: a host-side stall, recorded
+                        # so chaos runs show up in the timing series
+                        self.recorder.step_time(
+                            "straggler_wait",
+                            delay,
+                            nloop=nloop,
+                            group=gid,
+                            nadmm=nadmm,
+                        )
+                        time.sleep(delay)
+                    if m_np.sum() < self.cfg.n_clients:
+                        mask = self._put(
+                            m_np, client_sharding(self.mesh)
+                        )
                 t0 = time.perf_counter()
                 with jax.profiler.TraceAnnotation("consensus"):
                     self.flat, y, z, rho, extra, met = consensus_fn(
-                        self.flat, y, z, rho, extra, jnp.int32(nadmm)
+                        self.flat, y, z, rho, extra, jnp.int32(nadmm), mask
                     )
-                    dual, primal, mean_rho = (self._fetch(m) for m in met)
+                    dual, primal, mean_rho, survivors = (
+                        self._fetch(m) for m in met
+                    )
                 self.recorder.step_time(
                     "consensus",
                     time.perf_counter() - t0,
@@ -713,8 +796,21 @@ class Trainer:
                     nadmm=nadmm,
                     group_size=gsize,
                 )
+                if self.injector is not None:
+                    self.recorder.participation(
+                        int(survivors),
+                        cfg.n_clients,
+                        nloop=nloop,
+                        group=gid,
+                        nadmm=nadmm,
+                    )
             if check:
                 self._check_params(nloop=nloop, group=gid, nadmm=nadmm)
+            if self.injector is not None:
+                # planned crash AFTER the round's consensus exchange —
+                # exactly the state an outer-loop checkpoint mid-flight
+                # would recover through resume='auto' (fault/injector.py)
+                self.injector.maybe_crash(nloop, gid, nadmm)
             if cfg.check_results and not (
                 cfg.eval_every_batch and cfg.strategy == "none"
                 # params unchanged since the last per-batch eval (no
@@ -726,6 +822,22 @@ class Trainer:
                 )
         if cfg.strategy == "admm":
             self._rho_store[gid] = rho
+        if rollback and self._round_poisoned:
+            # transactional rollback: discard the poisoned round wholesale
+            # and continue from its entry state. Everything else a round
+            # produces (lstate, y, z) is re-initialized per round anyway.
+            # The snapshots are XLA-owned device copies — safe to adopt
+            # directly (and to be donated by the next round's epoch fn).
+            self.flat = snap_flat
+            self.stats = snap_stats
+            if snap_rho is not None:
+                self._rho_store[gid] = snap_rho
+            else:
+                self._rho_store.pop(gid, None)
+            self.recorder.fault(
+                "round_rollback", [], nloop=nloop, group=gid
+            )
+            self._round_poisoned = False
 
     def run(self) -> MetricsRecorder:
         """The full experiment (all Nloop outer loops).
@@ -792,14 +904,30 @@ class Trainer:
                 [self._batchers[c].is_native for c in sorted(self._batchers)],
                 np.int64,
             )
+        path = checkpoint_path(self.cfg.checkpoint_dir, step)
+        if jax.process_count() > 1:
+            # single-writer: `state` is byte-identical on every process
+            # (_fetch allgathers), and save_checkpoint's host-side staging
+            # (rmtree + os.replace) must not race on a shared directory —
+            # process 0 writes, everyone else waits at the barrier so no
+            # process runs ahead of a checkpoint it may need to resume from
+            from jax.experimental import multihost_utils
+
+            if jax.process_index() == 0:
+                save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
+            multihost_utils.sync_global_devices(f"checkpoint_step_{step}")
+            return path
         return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
 
     def _restore(self) -> None:
         state = load_checkpoint(self.cfg.checkpoint_dir)
         csh = client_sharding(self.mesh)
-        self.flat = self._put(state["flat"], csh)
+        # _owned_copy: flat/stats flow into the epoch fn's donated slots;
+        # they must not remain zero-copy views of the (soon-freed)
+        # checkpoint host arrays (see module header)
+        self.flat = _owned_copy(self._put(state["flat"], csh))
         self.stats = jax.tree.map(
-            lambda x: self._put(x, csh), state["batch_stats"]
+            lambda x: _owned_copy(self._put(x, csh)), state["batch_stats"]
         )
         self._completed_nloops = int(state["completed_nloops"])
         if self._qkv_layout is not None:
@@ -814,7 +942,7 @@ class Trainer:
                     "or convert the checkpoint"
                 )
         for g, r in state.get("rho_store", {}).items():
-            self._rho_store[int(g)] = self._put(r, csh)
+            self._rho_store[int(g)] = _owned_copy(self._put(r, csh))
         if not self._stream and "stream_positions" in state:
             # the mirror-image mismatch: a streaming checkpoint resumed
             # resident would silently continue under the reseeded

@@ -1,0 +1,88 @@
+"""Runtime side of fault injection: plan lookup + fire-once crash points.
+
+The `FaultPlan` is pure; the `FaultInjector` is the small stateful shim
+between it and the `Trainer`. Masks and delays pass straight through. The
+one piece of state is crash arming: a crash point must fire exactly once
+per *experiment* (not once per process), or the resumed run would march
+into the same planned crash again and never finish. Fired points are
+recorded as sentinel files under the checkpoint directory — the same
+durability domain as the checkpoints the resume path reads — so a fresh
+process (`--resume auto`) skips them. Without a state dir (no
+checkpointing configured) the record is process-local, which still
+guarantees single-fire for in-process restarts but makes a planned crash
+of a non-checkpointing run fatal — loudly, by design: there is nothing to
+resume from.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Set
+
+import numpy as np
+
+from federated_pytorch_test_tpu.fault.plan import FaultPlan, InjectedCrash
+
+
+class FaultInjector:
+    """Per-run fault dispenser for one `FaultPlan`."""
+
+    def __init__(
+        self, plan: FaultPlan, n_clients: int, state_dir: Optional[str] = None
+    ):
+        self.plan = plan
+        self.n_clients = n_clients
+        self.state_dir = os.path.abspath(state_dir) if state_dir else None
+        # sentinels are scoped to THIS plan: a different plan sharing the
+        # checkpoint dir (new seed, new crash schedule) must not have its
+        # crash points suppressed by a previous experiment's leftovers
+        self._plan_tag = hashlib.md5(plan.to_json().encode()).hexdigest()[:8]
+        self._fired: Set[str] = set()
+
+    def mask(self, nloop: int, gid: int, nadmm: int) -> np.ndarray:
+        """`[K]` float32 participation mask for one consensus round."""
+        return self.plan.participation(self.n_clients, nloop, gid, nadmm)
+
+    def straggler_delay(self, nloop: int, gid: int, nadmm: int) -> float:
+        return self.plan.straggler_delay(nloop, gid, nadmm)
+
+    # ---------------------------------------------------------- crash points
+
+    def _sentinel(self, key: str) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        return os.path.join(
+            self.state_dir, f".crash_fired_{self._plan_tag}_{key}"
+        )
+
+    def _already_fired(self, key: str) -> bool:
+        if key in self._fired:
+            return True
+        path = self._sentinel(key)
+        return path is not None and os.path.exists(path)
+
+    def maybe_crash(self, nloop: int, gid: int, nadmm: int) -> None:
+        """Raise `InjectedCrash` if the plan names this round — once only.
+
+        The sentinel is written BEFORE raising: if the write itself fails,
+        the crash does not fire (a chaos plan must never be able to wedge
+        an experiment into a crash loop).
+        """
+        point = self.plan.crash_at(nloop, gid, nadmm)
+        if point is None:
+            return
+        key = point.key()
+        if self._already_fired(key):
+            return
+        path = self._sentinel(key)
+        if path is not None:
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(path, "w") as f:
+                f.write("fired\n")
+        self._fired.add(key)
+        raise InjectedCrash(
+            f"planned crash at round (nloop={nloop}, gid={gid}, "
+            f"nadmm={nadmm}); restart with resume='auto' to recover from "
+            "the latest checkpoint"
+        )

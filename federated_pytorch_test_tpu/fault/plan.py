@@ -1,0 +1,187 @@
+"""Deterministic failure-injection schedules: the chaos that replays.
+
+The reference trio simulates three always-alive clients in one process,
+so every client survives every round by construction (SURVEY.md §2.4 —
+there is no transport to fail). Real federated deployments drop clients,
+straggle, and crash mid-round; TAMUNA (arXiv:2302.09832) treats partial
+participation as a first-class algorithmic regime and FedADMM
+(arXiv:2204.03529) shows ADMM consensus absorbs system heterogeneity when
+the aggregation is participation-aware.
+
+A `FaultPlan` is the *schedule* of those failures, and nothing else: every
+fault it describes is a pure function of `(plan.seed, round cursor)`,
+where the round cursor is the trainer's `(nloop, gid, nadmm)` triple. Two
+runs of the same plan therefore inject byte-identical faults regardless of
+wall-clock, host count, or how often the run crashed and resumed — the
+"resumed run replays the exact trajectory" invariant of
+`utils/checkpoint.py` extends to injected faults (docs/FAULT.md).
+
+Three fault kinds:
+
+* **dropout** — each client independently misses a consensus round with
+  probability `dropout_p` (it trains locally but its contribution is
+  excluded from the masked aggregation and it does not receive the
+  broadcast; see consensus/fedavg.py, consensus/admm.py);
+* **stragglers** — a round stalls for `straggler_delay_s` host-side
+  seconds with probability `straggler_p` (the coordinator waiting out a
+  slow client before declaring it dropped);
+* **crashes** — the process raises `InjectedCrash` at a named round
+  boundary, exercising checkpoint/resume (`--resume auto`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+class InjectedCrash(RuntimeError):
+    """A planned crash point fired (see FaultPlan.crashes)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashPoint:
+    """Crash AFTER the consensus exchange of round `(nloop, gid, nadmm)`.
+
+    The boundary is chosen so a crashed run holds exactly the state an
+    outer-loop checkpoint would capture mid-flight: resume restarts the
+    interrupted outer loop from the last checkpoint and deterministically
+    replays the rounds before the crash point (docs/FAULT.md).
+    """
+
+    nloop: int
+    gid: int
+    nadmm: int
+
+    def key(self) -> str:
+        return f"{self.nloop}_{self.gid}_{self.nadmm}"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A replayable chaos schedule (all faults pure in seed + cursor)."""
+
+    seed: int = 0
+    dropout_p: float = 0.0
+    straggler_p: float = 0.0
+    straggler_delay_s: float = 0.0
+    crashes: Tuple[CrashPoint, ...] = ()
+
+    def __post_init__(self):
+        for name in ("dropout_p", "straggler_p"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.straggler_delay_s < 0:
+            raise ValueError(
+                f"straggler_delay_s must be >= 0, got {self.straggler_delay_s}"
+            )
+
+    # ------------------------------------------------------------- schedule
+
+    def _rng(self, nloop: int, gid: int, nadmm: int) -> np.random.Generator:
+        # the same SeedSequence folding as the trainer's epoch shuffles
+        # (engine/trainer.py _epoch_seed): deterministic in (seed, cursor),
+        # independent across rounds
+        return np.random.default_rng(
+            [self.seed & 0x7FFFFFFF, nloop, gid, nadmm]
+        )
+
+    def participation(
+        self, n_clients: int, nloop: int, gid: int, nadmm: int
+    ) -> np.ndarray:
+        """`[K]` float32 mask for one consensus round: 1 = alive, 0 = dropped.
+
+        Pure in (seed, cursor) — NOT in execution history, so a resumed
+        run re-derives the identical mask for a replayed round. All-dropped
+        rounds are allowed; the masked aggregation degenerates to keeping
+        the previous consensus state (consensus/fedavg.py).
+        """
+        rng = self._rng(nloop, gid, nadmm)
+        if self.dropout_p <= 0.0:
+            return np.ones(n_clients, np.float32)
+        return (rng.random(n_clients) >= self.dropout_p).astype(np.float32)
+
+    def straggler_delay(self, nloop: int, gid: int, nadmm: int) -> float:
+        """Host-side seconds this round's consensus stalls (0 = no straggler)."""
+        if self.straggler_p <= 0.0 or self.straggler_delay_s <= 0.0:
+            return 0.0
+        # a separate fold from participation so adding stragglers to a plan
+        # does not perturb its dropout masks
+        rng = np.random.default_rng(
+            [(self.seed + 1) & 0x7FFFFFFF, nloop, gid, nadmm]
+        )
+        return self.straggler_delay_s if rng.random() < self.straggler_p else 0.0
+
+    def crash_at(self, nloop: int, gid: int, nadmm: int) -> CrashPoint | None:
+        for c in self.crashes:
+            if (c.nloop, c.gid, c.nadmm) == (nloop, gid, nadmm):
+                return c
+        return None
+
+    # -------------------------------------------------------- serialization
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["crashes"] = [dataclasses.asdict(c) for c in self.crashes]
+        return json.dumps(d, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        crashes = tuple(CrashPoint(**c) for c in d.pop("crashes", []))
+        return cls(crashes=crashes, **d)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Build a plan from a CLI `--fault-plan` value.
+
+        Accepts (1) a path to a JSON file written by `to_json`, or (2) an
+        inline spec of comma-separated `key=value` pairs:
+
+            seed=1,dropout=0.3,straggler=0.1:0.5,crash=0:1:2
+
+        where `straggler=p:delay_s` and each `crash=nloop:gid:nadmm` names
+        one crash point (repeatable).
+        """
+        if os.path.exists(spec):
+            with open(spec) as f:
+                return cls.from_json(f.read())
+        kw: dict = {}
+        crashes = []
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(
+                    f"bad fault-plan item {item!r} (want key=value); "
+                    f"note {spec!r} is also not an existing file path"
+                )
+            key, val = item.split("=", 1)
+            key = key.strip()
+            if key == "seed":
+                kw["seed"] = int(val)
+            elif key == "dropout":
+                kw["dropout_p"] = float(val)
+            elif key == "straggler":
+                p, _, delay = val.partition(":")
+                kw["straggler_p"] = float(p)
+                kw["straggler_delay_s"] = float(delay) if delay else 1.0
+            elif key == "crash":
+                parts = val.split(":")
+                if len(parts) != 3:
+                    raise ValueError(
+                        f"crash point {val!r} must be nloop:gid:nadmm"
+                    )
+                crashes.append(CrashPoint(*(int(p) for p in parts)))
+            else:
+                raise ValueError(
+                    f"unknown fault-plan key {key!r} "
+                    "(have seed, dropout, straggler, crash)"
+                )
+        return cls(crashes=tuple(crashes), **kw)

@@ -49,6 +49,7 @@ from federated_pytorch_test_tpu.parallel.tensor import (
     shard_params_tp,
     tp_param_specs,
 )
+from federated_pytorch_test_tpu.parallel.shardmap import shard_map
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
@@ -65,6 +66,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 
 __all__ = [
     "mark_varying",
+    "shard_map",
     "CLIENT_AXIS",
     "EXPERT_AXIS",
     "MODEL_AXIS",

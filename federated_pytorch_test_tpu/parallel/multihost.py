@@ -22,6 +22,7 @@ to the plain `client_mesh` — the same code runs everywhere.
 from __future__ import annotations
 
 import os
+import time
 import warnings
 
 import jax
@@ -53,6 +54,8 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    max_attempts: int = 5,
+    backoff_s: float = 2.0,
 ) -> int:
     """Initialize JAX's multi-process runtime; returns this process' id.
 
@@ -62,26 +65,62 @@ def initialize_distributed(
     `jax.devices()` counts). A no-op (returning 0) when single-process
     (nothing configured and no arguments given).
 
-    When a multi-host run IS configured, an initialization failure
-    raises: continuing would leave every host training the whole job
-    independently, racing on checkpoints — worse than a loud crash.
+    On pods the coordinator process routinely comes up seconds after the
+    workers (pod schedulers give no start-order guarantee), so the
+    connection is retried with exponential backoff — `max_attempts` tries,
+    `backoff_s * 2**attempt` seconds between them (capped at 30 s per
+    wait). A failed `jax.distributed.initialize` leaves partial global
+    state behind (the client object is created before connect()), and a
+    second call against that state dies instantly on "should only be
+    called once" instead of touching the network — so every failed
+    attempt is followed by a best-effort `jax.distributed.shutdown()` to
+    make the next connect real. When every attempt fails, the LAST error
+    raises loudly: continuing would leave every host training the whole
+    job independently, racing on checkpoints — worse than a crash.
     """
     # decide from env/args alone — probing jax.process_count() here would
     # itself initialize the backend and break the multi-process path
     if coordinator_address is None and num_processes is None:
         if not _env_signals_multihost():
             return 0  # single-process run
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:
-        if "already" in str(e).lower():  # double-initialize: benign
+    if max_attempts < 1:
+        raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+    last: Exception | None = None
+    for attempt in range(max_attempts):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
             return jax.process_index()
-        raise
-    return jax.process_index()
+        except RuntimeError as e:
+            msg = str(e).lower()
+            if attempt == 0 and ("already" in msg or "called once" in msg):
+                # the runtime was initialized before we were called:
+                # benign. Only trustworthy on the FIRST attempt — after
+                # our own failed connect the same message just means the
+                # broken partial state was not cleared.
+                return jax.process_index()
+            last = e
+            try:  # clear the partial init state so the retry reconnects
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt + 1 < max_attempts:
+                delay = min(backoff_s * (2.0 ** attempt), 30.0)
+                warnings.warn(
+                    f"jax.distributed.initialize failed (attempt "
+                    f"{attempt + 1}/{max_attempts}): {e}; coordinator may "
+                    f"not be up yet — retrying in {delay:.1f}s"
+                )
+                time.sleep(delay)
+    raise RuntimeError(
+        f"jax.distributed.initialize failed after {max_attempts} attempts; "
+        "a configured multi-host run MUST NOT fall back to independent "
+        "single-process training (checkpoint races, split-brain consensus) "
+        f"— last error: {last}"
+    ) from last
 
 
 def _dcn_islands() -> tuple[int, bool]:

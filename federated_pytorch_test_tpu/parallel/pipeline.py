@@ -173,7 +173,7 @@ def pipeline_apply(
         )
     pspec = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
 
-    from jax import shard_map
+    from federated_pytorch_test_tpu.parallel.shardmap import shard_map
 
     run = shard_map(
         lambda prm, x: spmd_pipeline(fn, prm, x),

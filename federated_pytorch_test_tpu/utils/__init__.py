@@ -2,6 +2,7 @@
 
 from federated_pytorch_test_tpu.utils.metrics import MetricsRecorder
 from federated_pytorch_test_tpu.utils.checkpoint import (
+    checkpoint_path,
     load_checkpoint,
     save_checkpoint,
 )
@@ -14,6 +15,7 @@ from federated_pytorch_test_tpu.utils.hostcpu import (
 __all__ = [
     "compile_cache_dir",
     "MetricsRecorder",
+    "checkpoint_path",
     "load_checkpoint",
     "save_checkpoint",
     "force_host_cpu",

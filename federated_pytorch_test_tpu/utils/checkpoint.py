@@ -17,12 +17,20 @@ that outlives a round (allocated once outside the reference's loops,
 src/consensus_admm_trio.py:263, hence `Trainer._rho_store` and its slot
 in the checkpoint), and epoch shuffles are a pure function of
 (seed, loop indices) — so a resumed run replays the exact trajectory it
-would have taken.
+would have taken. That invariant extends to injected faults: a FaultPlan's
+dropout masks and straggler stalls are pure functions of (plan seed, round
+cursor) too (fault/plan.py), so a chaos run resumed after a crash replays
+the same masked-aggregation trajectory the uninterrupted run takes
+(docs/FAULT.md). Writes are atomic — staged under `.tmp_step_N`, then
+os.replace'd — and the loader falls back past unreadable checkpoints, so
+a crash can interrupt any instant of a run without wedging its resume.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
+import warnings
 from typing import Any
 
 import jax
@@ -37,34 +45,79 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_checkpoint(directory: str, state: PyTree, *, step: int) -> str:
-    """Write `state` (any pytree of arrays/scalars) under `directory/step_N`.
+def checkpoint_path(directory: str, step: int) -> str:
+    """The ONE place that knows the `directory/step_N` layout."""
+    return os.path.join(os.path.abspath(directory), f"step_{step}")
 
-    Returns the checkpoint path. Existing checkpoint at the same step is
-    overwritten (the reference likewise clobbers `./sK.model`).
+
+def _list_steps(root: str) -> list[int]:
+    # hidden ".tmp_step_N" staging dirs are invisible here by construction
+    return sorted(
+        int(d.split("_", 1)[1])
+        for d in (os.listdir(root) if os.path.isdir(root) else [])
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+    )
+
+
+def save_checkpoint(directory: str, state: PyTree, *, step: int) -> str:
+    """ATOMICALLY write `state` (a pytree of arrays) under `directory/step_N`.
+
+    The tree is first materialized under the hidden staging path
+    `directory/.tmp_step_N` — which `load_checkpoint` never considers —
+    then `os.replace`d into its final name, so a crash mid-write can never
+    leave a torn `step_N` for the resume path to trip on: either the
+    rename happened (complete checkpoint) or it didn't (no checkpoint; the
+    loader falls back to the previous one). An existing checkpoint at the
+    same step is overwritten (the reference likewise clobbers
+    `./sK.model`); the brief gap while the stale tree is cleared is
+    likewise covered by the loader's fall-back-to-next-newest.
+
+    Returns the final checkpoint path.
     """
-    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    root = os.path.abspath(directory)
+    path = checkpoint_path(directory, step)
+    tmp = os.path.join(root, f".tmp_step_{step}")
     state = jax.tree.map(np.asarray, state)
-    _checkpointer().save(path, state, force=True)
+    os.makedirs(root, exist_ok=True)
+    if os.path.exists(tmp):  # leftover staging dir from a crashed writer
+        shutil.rmtree(tmp)
+    _checkpointer().save(tmp, state, force=True)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
     return path
 
 
 def load_checkpoint(directory: str, *, step: int | None = None) -> PyTree:
-    """Load the checkpoint at `step`, or the latest one if `step` is None.
+    """Load the checkpoint at `step`, or the newest READABLE one if None.
 
-    Raises FileNotFoundError when no checkpoint exists.
+    With `step=None`, unreadable/incomplete checkpoints (torn writes from
+    a crash predating the atomic writer, half-deleted trees, bad metadata)
+    are skipped with a warning and the next-newest is tried — a chaos run
+    resumes from the latest checkpoint that actually restores. With an
+    explicit `step`, failures propagate: the caller named a specific
+    checkpoint and silently substituting another would be worse.
+
+    Raises FileNotFoundError when no (readable) checkpoint exists.
     """
     root = os.path.abspath(directory)
-    if step is None:
-        steps = sorted(
-            int(d.split("_", 1)[1])
-            for d in (os.listdir(root) if os.path.isdir(root) else [])
-            if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-        )
-        if not steps:
-            raise FileNotFoundError(f"no checkpoints under {root}")
-        step = steps[-1]
-    path = os.path.join(root, f"step_{step}")
-    if not os.path.exists(path):
-        raise FileNotFoundError(path)
-    return _checkpointer().restore(path)
+    if step is not None:
+        path = checkpoint_path(directory, step)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        return _checkpointer().restore(path)
+    steps = _list_steps(root)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {root}")
+    for s in reversed(steps):
+        path = checkpoint_path(directory, s)
+        try:
+            return _checkpointer().restore(path)
+        except Exception as e:  # orbax raises several types on torn trees
+            warnings.warn(
+                f"skipping unreadable checkpoint {path}: {type(e).__name__}: "
+                f"{e}; falling back to the next-newest"
+            )
+    raise FileNotFoundError(
+        f"no readable checkpoint under {root} (tried steps {steps})"
+    )

@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -29,11 +30,34 @@ class MetricsRecorder:
 
     series: Dict[str, List[dict]] = dataclasses.field(default_factory=dict)
     verbose: bool = True
+    # cursor of the FIRST non-finite loss/residual observed, or None while
+    # the run is healthy (see _flag_nonfinite). Frozen once set: the first
+    # poisoned round is the diagnostic one, everything after is fallout.
+    first_nonfinite: Optional[dict] = None
     _t0: float = dataclasses.field(default_factory=time.perf_counter)
 
     def log(self, name: str, value: Any, **context) -> None:
         rec = {"t": time.perf_counter() - self._t0, "value": value, **context}
         self.series.setdefault(name, []).append(rec)
+
+    def _flag_nonfinite(self, name: str, values, context: dict) -> None:
+        """Flag the FIRST NaN/Inf observation with its loop cursor.
+
+        The reference lets a poisoned loss print as `nan` and scroll away
+        (its only guards live inside the optimizer, src/lbfgsnew.py:542);
+        here the first non-finite loss/residual pins the exact
+        (loop, group, round) cursor in `first_nonfinite` and a
+        `nonfinite_flag` series record, instead of propagating silently
+        through the remaining rounds.
+        """
+        if self.first_nonfinite is not None:
+            return
+        if any(not math.isfinite(v) for v in values):
+            self.first_nonfinite = {"series": name, **context}
+            self.log("nonfinite_flag", {"series": name, **context})
+            if self.verbose:
+                ctx = " ".join(f"{k}={v}" for k, v in context.items())
+                print(f"NONFINITE first non-finite {name} at {ctx}")
 
     def batch_losses(self, losses, *, nloop, group, nadmm, epoch, minibatch) -> None:
         """Per-client training losses for one lockstep minibatch.
@@ -42,15 +66,12 @@ class MetricsRecorder:
         (src/federated_trio.py:352).
         """
         vals = [float(v) for v in losses]
-        self.log(
-            "train_loss",
-            vals,
-            nloop=nloop,
-            group=group,
-            nadmm=nadmm,
-            epoch=epoch,
+        ctx = dict(
+            nloop=nloop, group=group, nadmm=nadmm, epoch=epoch,
             minibatch=minibatch,
         )
+        self._flag_nonfinite("train_loss", vals, ctx)
+        self.log("train_loss", vals, **ctx)
         if self.verbose:
             print(
                 f"layer={group} {nloop} minibatch={minibatch} epoch={epoch} "
@@ -67,6 +88,11 @@ class MetricsRecorder:
         (src/federated_trio.py:359).
         """
         ctx = dict(nloop=nloop, group=group, nadmm=nadmm)
+        self._flag_nonfinite(
+            "residuals",
+            [float(v) for v in (dual, primal) if v is not None],
+            ctx,
+        )
         self.log("dual_residual", float(dual), **ctx)
         if primal is not None:
             self.log("primal_residual", float(primal), **ctx)
@@ -114,6 +140,17 @@ class MetricsRecorder:
         if self.verbose:
             ctx = " ".join(f"{k}={v}" for k, v in context.items())
             print(f"step_time phase={phase} {ctx} seconds={seconds:.4f}")
+
+    def participation(self, survivors: int, k: int, **context) -> None:
+        """Surviving-client count of one masked consensus round.
+
+        Only recorded when a fault plan is active (engine/trainer.py), so
+        no-chaos runs keep their pre-fault metric series byte-identical.
+        """
+        self.log("participation", {"survivors": survivors, "clients": k}, **context)
+        if self.verbose:
+            ctx = " ".join(f"{k_}={v}" for k_, v in context.items())
+            print(f"participation {survivors}/{k} {ctx}")
 
     def fault(self, kind: str, clients, **context) -> None:
         """A detected client fault (non-finite loss/params).

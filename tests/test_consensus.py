@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from federated_pytorch_test_tpu.parallel import shard_map
 from jax.sharding import PartitionSpec as P
 
 from federated_pytorch_test_tpu.consensus import (

@@ -14,7 +14,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-shard_map = jax.shard_map
+from federated_pytorch_test_tpu.parallel import shard_map
 
 from federated_pytorch_test_tpu.parallel import (
     SEQ_AXIS,
@@ -444,7 +444,7 @@ def test_three_axis_mesh_composes_tp_and_ring():
         return out[None], stat
 
     pspec = jax.tree.map(lambda _: P(CLIENT_AXIS), params)
-    fwd = jax.shard_map(
+    fwd = shard_map(
         body,
         mesh=mesh3,
         in_specs=(pspec, P(CLIENT_AXIS, None, SEQ_AXIS, None)),
