@@ -2,8 +2,12 @@
 
 The FINAL stdout line is ONE compact JSON headline (the driver parses
 the last line of a bounded stdout tail, so it must stay short):
-  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
-   "mfu": ..., "mxu_pct_peak": ...}
+  {"metric": ..., "value": N, "unit": "samples/sec", "sps_p25": N,
+   "sps_p75": N, "vs_baseline": N, "mfu": ..., "mxu_pct_peak": ...}
+`value` is the MEDIAN of `BENCH_REPEATS` (default 5) timed runs with
+its p25/p75 dispersion alongside — the chip is shared and single draws
+range 160-2600 samples/s on the flagship (BASELINE.md), so a best-of-N
+minimum would publish the luckiest draw as if it were typical.
 The full record (roofline, sweep, MXU probe) is written to
 `benchmarks/bench_full.json` (gitignored scratch — a per-round snapshot
 `benchmarks/bench_full_r{N}.json` is committed so the docs' cited
@@ -76,8 +80,13 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
     Timing protocol (see memory: the tunneled chip lies to
     block_until_ready): `steps` lockstep minibatches inside ONE jitted
     scan amortize the ~0.1 s flat dispatch latency; a device->host
-    scalar fetch is the completion barrier; best-of-3 minimum because
-    the chip is shared.
+    scalar fetch is the completion barrier. The chip is SHARED, so a
+    single draw ranges wildly (BASELINE.md: 160-2600 samples/s on the
+    flagship) and a best-of-N minimum publishes the luckiest draw as if
+    it were typical; instead the row reports the MEDIAN of
+    `BENCH_REPEATS` (default 5) timed runs with its p25/p75 dispersion —
+    the flash benches' v2 timing discipline. Derived utilization numbers
+    (MFU, HBM, intensity) are computed from the median time.
     """
     import jax.numpy as jnp
     import numpy as np
@@ -131,12 +140,17 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
     flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
     float(jnp.sum(flat[:, 0]))
 
-    dt = float("inf")
-    for _ in range(3):
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "5")))
+    dts = []
+    for _ in range(repeats):
         t0 = time.perf_counter()
         flat, lstate, stats = run_epoch(flat, lstate, stats, idx)
         float(jnp.sum(flat[:, 0]))
-        dt = min(dt, time.perf_counter() - t0)
+        dts.append(time.perf_counter() - t0)
+    dt = float(np.median(dts))
+    # dispersion in throughput space: the FAST quartile of times is the
+    # p75 of samples/s and vice versa
+    dt_p25, dt_p75 = float(np.percentile(dts, 25)), float(np.percentile(dts, 75))
 
     n_samples = steps * k * batch
     row = {
@@ -144,7 +158,10 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
         "batch": batch,
         "dtype": dtype,
         "steps": steps,
+        "repeats": repeats,
         "samples_per_sec": round(n_samples / dt, 2),
+        "sps_p25": round(n_samples / dt_p75, 2),
+        "sps_p75": round(n_samples / dt_p25, 2),
         "epoch_time_s": round(dt, 4),
     }
     if flops:
@@ -160,12 +177,14 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
 
     # closure-evaluation accounting (the reference's one built-in counter,
     # src/lbfgsnew.py:508-510): value_and_grad evals per optimizer step,
-    # cumulative in the threaded L-BFGS state over 1 warmup + 3 timed runs
+    # cumulative in the threaded L-BFGS state over 1 warmup + the timed runs
     try:
         import jax
 
         fe = np.asarray(jax.tree.leaves(lstate.func_evals)[0]).reshape(-1)
-        row["mean_func_evals_per_step"] = round(float(fe.mean()) / (4 * steps), 2)
+        row["mean_func_evals_per_step"] = round(
+            float(fe.mean()) / ((1 + repeats) * steps), 2
+        )
     except Exception:
         pass
     return row
@@ -360,6 +379,12 @@ def main() -> None:
         "metric": out["metric"],
         "value": out["value"],
         "unit": out["unit"],
+        # medianized timing (BASELINE.md: single draws range 160-2600 on
+        # the shared chip): value is the median of BENCH_REPEATS runs,
+        # p25/p75 say how noisy this measurement session was
+        "sps_p25": flag.get("sps_p25"),
+        "sps_p75": flag.get("sps_p75"),
+        "repeats": flag.get("repeats"),
         "vs_baseline": out["vs_baseline"],
         "batch": out["batch"],
         "dtype": out["dtype"],

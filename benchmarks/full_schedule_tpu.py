@@ -49,6 +49,10 @@ def main() -> None:
     # the linearly-separable easy synthetic (every healthy config hits
     # 1.0 — useful only for throughput, not as an oracle)
     ap.add_argument("--separable", action="store_true")
+    # escape hatch: per-epoch dispatches instead of the fused one-
+    # dispatch round (engine/steps.py build_round_fn) — for measuring
+    # the dispatch tail the fusion harvests
+    ap.add_argument("--no-fuse-rounds", action="store_true")
     # load a REAL-FORMAT on-disk archive (scripts/make_cifar_archive.py
     # writes a checksum-verified one in the published binary layout) via
     # the real loader path — native bin decoding, no synthetic fallback
@@ -63,6 +67,8 @@ def main() -> None:
     assert jax.default_backend() == "tpu", jax.default_backend()
 
     over = {"nloop": args.nloop} if args.nloop is not None else {}
+    if args.no_fuse_rounds:
+        over["fuse_rounds"] = False
     if args.stream:
         over.update(hbm_data_budget_mb=0, stream_chunk_steps=8)
     if args.real_archive:
@@ -91,6 +97,18 @@ def main() -> None:
         e["value"]["seconds"]
         for e in rec.series.get("step_time", [])
         if e["value"].get("phase") == "epoch"
+    ]
+    # fused rounds (the default): one `fused_round` timing per partition
+    # round covering nadmm*(nepoch epochs + consensus). No derived
+    # per-epoch number — dividing the round time by nadmm*nepoch would
+    # fold the consensus collectives (and the first round's compile)
+    # into a figure the committed unfused runs report as PURE epoch
+    # dispatch time; fused runs leave epoch_step_time_median_s null and
+    # report the round median instead (compare via --no-fuse-rounds).
+    round_times = [
+        e["value"]["seconds"]
+        for e in rec.series.get("step_time", [])
+        if e["value"].get("phase") == "fused_round"
     ]
     out = {
         "experiment": f"full {args.preset} preset (complete reference schedule)"
@@ -124,6 +142,10 @@ def main() -> None:
         ],
         "epoch_step_time_median_s": (
             round(float(np.median(step_times)), 3) if step_times else None
+        ),
+        "fused_rounds": bool(round_times),
+        "fused_round_time_median_s": (
+            round(float(np.median(round_times)), 3) if round_times else None
         ),
     }
     if args.preset.startswith("admm"):

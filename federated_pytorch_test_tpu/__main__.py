@@ -9,6 +9,12 @@ scripts are presets and every constant is a flag:
     python -m federated_pytorch_test_tpu --preset admm --nloop 2 --no-bb-update
     python -m federated_pytorch_test_tpu --list-presets
 
+Rounds run FUSED by default — each partition group's full averaging
+round (every epoch + consensus exchange) is one jitted dispatch
+(engine/steps.py build_round_fn); `--no-fuse-rounds` restores the
+per-epoch dispatch path (bit-identical trajectory, more dispatch
+latency).
+
 Chaos runs (fault/, docs/FAULT.md) ride the same config surface:
 `--fault-plan "seed=1,dropout=0.3,crash=0:1:2"` (or a FaultPlan JSON
 path) injects replayable dropout/straggler/crash faults, and

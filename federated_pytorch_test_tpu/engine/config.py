@@ -142,6 +142,22 @@ class ExperimentConfig:
     # lockstep minibatches per streamed chunk (one jitted scan per chunk;
     # larger chunks amortize dispatch, smaller ones bound staging memory)
     stream_chunk_steps: int = 8
+    # fuse each partition group's FULL averaging round — all nepoch
+    # epochs plus the consensus/ADMM exchange, scanned over nadmm — into
+    # ONE jitted donated-carry program (engine/steps.py build_round_fn):
+    # one dispatch per round instead of nadmm*(nepoch+1), which on a
+    # dispatch-latency-bound runtime (~0.1 s floor per program,
+    # benchmarks/epoch_attribution.json) is most of the wall time of the
+    # full reference schedules. The fused trajectory is BIT-identical to
+    # the unfused path (tests/test_fused_round.py). `--no-fuse-rounds`
+    # is the escape hatch. The trainer falls back to the unfused path
+    # when fusion cannot preserve semantics or dispatch bounds:
+    # host-streaming data, eval_every_batch, per-epoch eval cadence
+    # (strategy 'none' with check_results), or a round whose total
+    # scanned steps nadmm*nepoch*S exceed max_scan_steps (the one-
+    # dispatch program would be exactly the long-scan shape that cap
+    # exists to avoid).
+    fuse_rounds: bool = True
     # cap on lockstep minibatches per RESIDENT jitted epoch call: epochs
     # longer than this run as ceil(S/cap) sequential calls over index
     # slices (bit-identical trajectory — the scan is sequential either

@@ -18,6 +18,16 @@ averaging round, reference src/federated_trio.py:353-363) and is its own
 tiny jitted collective; only the active group's coordinates cross the
 interconnect (reference README.md:2's bandwidth contract).
 
+On top of these per-dispatch builders, `build_round_fn` fuses a whole
+partition round — `nadmm x (nepoch epochs + consensus)` — into ONE jitted
+donated-carry program by scanning the same epoch body and consensus
+collective over the round's precomputed shuffle schedule and fault masks.
+One dispatch per round instead of `nadmm*(nepoch+1)` harvests the flat
+~0.1 s dispatch floor that dominates the dispatch-latency-bound schedules
+(benchmarks/epoch_attribution.json); the per-dispatch builders remain the
+`--no-fuse-rounds` escape hatch and serve the cases fusion cannot
+(streaming, per-batch eval, per-epoch eval cadence, over-cap scans).
+
 BatchNorm models thread a `batch_stats` collection through the scan.
 Deliberate deviation (SURVEY.md §7 hard part 5): the reference mutates
 running stats at EVERY closure evaluation inside the line search; here
@@ -260,9 +270,15 @@ def _client_train_step(ctx: GroupContext):
         flat = ctx.partition.insert(flat, ctx.gid, x1)
         if fold:
             data_loss_f, stats_f = aux.aux
+            entry_data_loss, _ = aux.entry_aux
             # NaN-step fallback (aux_ok False): the final point was never
-            # evaluated — report the entry objective and keep the stats
-            diag_loss = jnp.where(aux.aux_ok, data_loss_f, aux.loss)
+            # evaluated — report the ENTRY DATA loss and keep the stats.
+            # Reporting aux.loss here (the entry OBJECTIVE, penalties
+            # included) would silently change what the train_loss series
+            # means on exactly the poisoned steps fault detection cares
+            # about; the entry data loss keeps the series one meaning
+            # (penalty-free data loss, like the explicit-diag path).
+            diag_loss = jnp.where(aux.aux_ok, data_loss_f, entry_data_loss)
             stats = jax.tree.map(
                 lambda new, old: jnp.where(aux.aux_ok, new, old),
                 stats_f, stats,
@@ -427,20 +443,13 @@ def build_round_init_fn(ctx: GroupContext, mesh):
     return jax.jit(sharded)
 
 
-def build_consensus_fn(ctx: GroupContext, mesh):
-    """Jitted averaging/ADMM round over the active group's coordinates.
+def _consensus_local(ctx: GroupContext):
+    """The per-device consensus body, shared by the standalone consensus
+    program (`build_consensus_fn`) and the fused round (`build_round_fn`).
 
-    FedAvg: z = mean_k x_k, broadcast back into every client's params
-    (reference src/federated_trio.py:353-363). ADMM: BB-rho (if due),
-    weighted z-update, y-update; clients keep their own x (reference
-    src/consensus_admm_trio.py:395-513).
-
-    `mask` is the `[K]` participation vector of the round (fault/plan.py;
-    all-ones when no fault plan is active — bit-identical to the unmasked
-    math). FedAvg's broadcast-back honors it too: a dropped client missed
-    the round, so it keeps its own x instead of receiving znew and rejoins
-    from stale parameters — the partial-participation regime of TAMUNA
-    (arXiv:2302.09832). Metrics gain the psum'd survivor count.
+    `(flat, y, z, rho, extra, nadmm, mask) -> (flat, y, z, rho, extra,
+    (dual, primal, mean_rho, survivors))`. Returns None for strategy
+    'none' (independent training has no consensus exchange).
     """
     if ctx.strategy == "none":
         return None
@@ -481,6 +490,28 @@ def build_consensus_fn(ctx: GroupContext, mesh):
                 met.survivors,
             )
 
+    return local
+
+
+def build_consensus_fn(ctx: GroupContext, mesh):
+    """Jitted averaging/ADMM round over the active group's coordinates.
+
+    FedAvg: z = mean_k x_k, broadcast back into every client's params
+    (reference src/federated_trio.py:353-363). ADMM: BB-rho (if due),
+    weighted z-update, y-update; clients keep their own x (reference
+    src/consensus_admm_trio.py:395-513).
+
+    `mask` is the `[K]` participation vector of the round (fault/plan.py;
+    all-ones when no fault plan is active — bit-identical to the unmasked
+    math). FedAvg's broadcast-back honors it too: a dropped client missed
+    the round, so it keeps its own x instead of receiving znew and rejoins
+    from stale parameters — the partial-participation regime of TAMUNA
+    (arXiv:2302.09832). Metrics gain the psum'd survivor count.
+    """
+    local = _consensus_local(ctx)
+    if local is None:
+        return None
+
     c = P(CLIENT_AXIS)
     r = P()
     sharded = shard_map(
@@ -493,6 +524,154 @@ def build_consensus_fn(ctx: GroupContext, mesh):
     # no donation here: the round-init placeholders alias buffers (e.g.
     # the fedavg extra=(y, y)) and these arrays are one group wide anyway
     return jax.jit(sharded)
+
+
+def build_round_fn(
+    ctx: GroupContext,
+    mesh,
+    *,
+    nadmm: int,
+    nepoch: int,
+    snapshot: bool = False,
+):
+    """One partition group's FULL averaging round as ONE jitted program.
+
+    The unfused round is `nadmm * (nepoch + 1)` separately dispatched XLA
+    programs (epochs + consensus), and on dispatch-latency-bound runtimes
+    each dispatch pays a flat ~0.1 s floor (benchmarks/
+    epoch_attribution.json) — the wall for the batch-32 flagship. Here the
+    whole round is one `lax.scan` over the `nadmm` consensus iterations,
+    each scan step running the epoch minibatch scan (`nepoch * S` steps of
+    the SAME body `build_epoch_fn` scans) followed by the consensus body
+    (`_consensus_local` — the identical collective). One dispatch per
+    round; the trajectory is bit-identical to the unfused path because
+    scan iterations execute the identical per-step computation in the
+    identical order (the same property `max_scan_steps` chunking relies
+    on, tests/test_engine.py::test_resident_auto_chunking_is_bit_identical).
+
+    Signature:
+      (flat [K,N], lstate, stats, shard_imgs [K,n,H,W,C] u8,
+       shard_labels [K,n], idx [nadmm, nepoch, S, K, B],
+       mean [K], std [K], y [K,G], z [G], rho [K,1], extra,
+       masks [nadmm, K])
+      -> (flat, lstate, stats, y, z, rho, extra,
+          losses [nadmm, nepoch, S, K],
+          met (dual, primal, mean_rho, survivors) each [nadmm],
+          param_ok [nadmm, K] bool,
+          snaps)
+
+    * `idx` is the whole round's shuffle schedule, precomputed host-side
+      (the trainer stacks its deterministic per-(nadmm, epoch)
+      `_epoch_indices` draws), fed as scan xs.
+    * `masks [nadmm, K]` are the per-consensus-round participation masks
+      (fault/injector.py `masks_for_round`), scan xs; all-ones without a
+      fault plan — bit-identical to the maskless math.
+    * `param_ok` is the `fault_mode` parameter check as on-device flags:
+      per-client post-consensus finiteness, accumulated across the scan
+      and inspected ONCE per round by the host (the rollback round is
+      transactional, so the per-nadmm inspection the unfused path does
+      adds nothing but dispatches). Loss finiteness is inspected from the
+      returned `losses` — already a round output for telemetry.
+    * `snaps` (static `snapshot=True` only, else `()`): the
+      `(flat, stats)` state after EVERY consensus exchange,
+      `[nadmm, K, ...]` — what `check_results`' per-round eval cadence
+      reads, since mid-round state is otherwise fused away. Eval itself
+      stays OUTSIDE the fused program.
+
+    `nadmm`/`nepoch` are static (they shape the scan); donation matches
+    `build_epoch_fn` (flat/lstate/stats update in place).
+    """
+    client_step = _client_train_step(ctx)
+    consensus_local = _consensus_local(ctx)
+
+    def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
+              y, z, rho, extra, masks):
+
+        def round_body(carry, xs):
+            flat, lstate, stats, y, z, rho, extra = carry
+            idx_a, mask_a, na = xs  # [nepoch, S, K_loc, B], [K_loc], i32
+            # replicated consensus vector -> varying for the closed-over
+            # L-BFGS while_loop (see build_epoch_fn); the CARRY keeps the
+            # unvarying z so its type is stable across scan iterations
+            # (the consensus psum emits an unvarying znew)
+            zv = mark_varying(z, CLIENT_AXIS)
+
+            def batch_body(c, idx_t):
+                flat, lstate, stats = c
+                images = jnp.take_along_axis(
+                    shard_imgs, idx_t[:, :, None, None, None], axis=1
+                )
+                labels = jnp.take_along_axis(shard_labels, idx_t, axis=1)
+                flat, lstate, stats, losses = jax.vmap(
+                    client_step,
+                    in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, 0),
+                )(flat, lstate, stats, images, labels, mean, std, y, zv, rho)
+                return (flat, lstate, stats), losses
+
+            # the epoch boundary is invisible to the minibatch body (a
+            # fresh shuffle is just the next idx rows), so nepoch epochs
+            # flatten into one [nepoch*S] scan — iteration-for-iteration
+            # the sequence the unfused path runs as nepoch programs
+            s = idx_a.shape[1]
+            (flat, lstate, stats), losses = lax.scan(
+                batch_body,
+                (flat, lstate, stats),
+                idx_a.reshape((nepoch * s,) + idx_a.shape[2:]),
+            )
+            losses = losses.reshape((nepoch, s) + losses.shape[1:])
+
+            if consensus_local is not None:
+                flat, y, z, rho, extra, met = consensus_local(
+                    flat, y, z, rho, extra, na, mask_a
+                )
+            else:
+                zeros = jnp.zeros((), flat.dtype)
+                met = (zeros, zeros, zeros, zeros)
+            param_ok = jnp.isfinite(flat).all(axis=tuple(range(1, flat.ndim)))
+
+            ys = (losses, met, param_ok)
+            if snapshot:
+                ys = ys + ((flat, stats),)
+            return (flat, lstate, stats, y, z, rho, extra), ys
+
+        carry = (flat, lstate, stats, y, z, rho, extra)
+        na_seq = jnp.arange(nadmm, dtype=jnp.int32)
+        carry, ys = lax.scan(round_body, carry, (idx, masks, na_seq))
+        flat, lstate, stats, y, z, rho, extra = carry
+        losses, met, param_ok = ys[:3]
+        snaps = ys[3] if snapshot else ()
+        return (flat, lstate, stats, y, z, rho, extra,
+                losses, met, param_ok, snaps)
+
+    c = P(CLIENT_AXIS)
+    r = P()
+    sc1 = P(None, CLIENT_AXIS)  # [nadmm, K, ...]
+    in_specs = (
+        c, c, c, c, c,
+        P(None, None, None, CLIENT_AXIS),  # idx [nadmm, nepoch, S, K, B]
+        c, c, c, r, c, (c, c),
+        sc1,  # masks [nadmm, K]
+    )
+    out_specs = (
+        c, c, c, c, r, c, (c, c),
+        P(None, None, None, CLIENT_AXIS),  # losses [nadmm, nepoch, S, K]
+        (r, r, r, r),  # per-nadmm metric series
+        sc1,  # param_ok [nadmm, K]
+        (sc1, sc1) if snapshot else (),  # post-consensus state snapshots
+    )
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=_check_vma(ctx),
+    )
+    # donated carry: params/opt-state/batch-stats are consumed and
+    # re-emitted every round, exactly as in build_epoch_fn. y/z/rho/extra
+    # are NOT donated — the round-init placeholders alias buffers (e.g.
+    # the fedavg extra=(y, y)), same reason build_consensus_fn never
+    # donates.
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
 
 def build_eval_fn(model, unravel, has_stats: bool, mesh):

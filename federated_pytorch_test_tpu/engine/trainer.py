@@ -4,9 +4,13 @@ One `Trainer` replaces all five reference driver scripts (SURVEY.md §1:
 they are near-clones differing only in model, loop sizes, and which
 coordination algorithm is inlined). The loop nest is the reference's
 `Nloop { groups { Nadmm { epochs { batches } } } }`
-(reference src/federated_trio.py:11-14,256-285), but each `{batches}` body
-is ONE jitted sharded epoch call and each consensus exchange is one jitted
-collective (see `engine/steps.py`).
+(reference src/federated_trio.py:11-14,256-285). By default the whole
+`Nadmm { epochs { batches } + consensus }` body of one partition round is
+ONE jitted dispatch (`_run_round_fused`, engine/steps.py build_round_fn);
+with `--no-fuse-rounds` (or where fusion cannot preserve semantics —
+`_fused_enabled`) each `{batches}` body is one jitted sharded epoch call
+and each consensus exchange one jitted collective, the same trajectory
+bit for bit.
 """
 
 from __future__ import annotations
@@ -26,6 +30,7 @@ from federated_pytorch_test_tpu.engine.steps import (
     build_consensus_fn,
     build_epoch_fn,
     build_eval_fn,
+    build_round_fn,
     build_round_init_fn,
     build_stream_epoch_fn,
 )
@@ -254,6 +259,7 @@ class Trainer:
         self._epoch_fns: Dict[int, Any] = {}
         self._consensus_fns: Dict[int, Any] = {}
         self._init_fns: Dict[int, Any] = {}
+        self._round_fns: Dict[int, Any] = {}  # fused one-dispatch rounds
         self._eval_fn = None
         self._health_fn = None
         self._completed_nloops = 0
@@ -398,6 +404,54 @@ class Trainer:
             self._init_fns[gid] = build_round_init_fn(ctx, self.mesh)
         return self._epoch_fns[gid], self._consensus_fns[gid], self._init_fns[gid]
 
+    def _init_fn(self, gid: int):
+        if gid not in self._init_fns:
+            self._init_fns[gid] = build_round_init_fn(self._ctx(gid), self.mesh)
+        return self._init_fns[gid]
+
+    def _fused_enabled(self) -> bool:
+        """Whether `run_round` takes the fused one-dispatch path.
+
+        Fusion must preserve the unfused semantics exactly, so it stands
+        down when it cannot:
+        * host-streaming data — minibatches are assembled per chunk on
+          the host, which is inherently multi-dispatch;
+        * `eval_every_batch` — the jitted eval sweep must interleave with
+          single minibatches;
+        * strategy 'none' with `check_results` — independent training
+          evaluates per EPOCH, and the fused program only snapshots state
+          at consensus boundaries;
+        * rounds whose total scanned steps `nadmm*nepoch*S` exceed
+          `max_scan_steps` — one fused dispatch would be exactly the
+          long-scan program shape that cap exists to keep off fragile
+          TPU runtimes (benchmarks/scan_bisect_tpu.py).
+        """
+        cfg = self.cfg
+        if not cfg.fuse_rounds or self._stream:
+            return False
+        if cfg.check_results and cfg.eval_every_batch:
+            return False
+        if cfg.strategy == "none" and cfg.check_results:
+            return False
+        if cfg.max_scan_steps is not None:
+            s = self.fed.steps_per_epoch(cfg.batch)
+            if cfg.nadmm * cfg.nepoch * s > cfg.max_scan_steps:
+                return False
+        return True
+
+    def _round_fn(self, gid: int):
+        if gid not in self._round_fns:
+            self._round_fns[gid] = build_round_fn(
+                self._ctx(gid),
+                self.mesh,
+                nadmm=self.cfg.nadmm,
+                nepoch=self.cfg.nepoch,
+                # mid-round state only needs materializing when the
+                # per-consensus-round eval cadence will read it
+                snapshot=self.cfg.check_results,
+            )
+        return self._round_fns[gid]
+
     @property
     def eval_fn(self):
         if self._eval_fn is None:
@@ -408,8 +462,8 @@ class Trainer:
 
     # ------------------------------------------------------------- training
 
-    def _epoch_indices(self, *loop_ids: int) -> jnp.ndarray:
-        """Per-client shuffled lockstep batch indices `[S, K, B]`.
+    def _epoch_indices_host(self, *loop_ids: int) -> np.ndarray:
+        """Per-client shuffled lockstep batch indices `[S, K, B]` (host).
 
         The `SubsetRandomSampler` equivalent (reference
         src/no_consensus_trio.py:59-61): each client reshuffles its own
@@ -421,11 +475,35 @@ class Trainer:
         rng = _epoch_seed(self.cfg.seed + 69, *loop_ids)
         perms = np.stack([rng.permutation(n) for _ in range(k)])  # [K, n]
         idx = perms[:, : s * b].reshape(k, s, b).transpose(1, 0, 2)  # [S,K,B]
-        # committed to the epoch fn's in_spec; _put keeps this correct on
-        # multi-host meshes (each host supplies its own client columns of
-        # the deterministic permutation)
+        return idx.astype(np.int32)
+
+    def _epoch_indices(self, *loop_ids: int) -> jnp.ndarray:
+        """One epoch's indices, placed for the epoch fn's in_spec."""
+        # _put keeps this correct on multi-host meshes (each host supplies
+        # its own client columns of the deterministic permutation)
         sh = NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS))
-        return self._put(idx.astype(np.int32), sh)
+        return self._put(self._epoch_indices_host(*loop_ids), sh)
+
+    def _round_indices(self, nloop: int, gid: int) -> jnp.ndarray:
+        """The whole round's shuffle schedule `[nadmm, nepoch, S, K, B]`.
+
+        Row (a, e) is EXACTLY the unfused path's `_epoch_indices(nloop,
+        gid, a, e)` draw, so the fused scan consumes the identical
+        minibatch sequence (the bit-identity contract of
+        tests/test_fused_round.py).
+        """
+        cfg = self.cfg
+        idx = np.stack([
+            np.stack([
+                self._epoch_indices_host(nloop, gid, a, e)
+                for e in range(cfg.nepoch)
+            ])
+            for a in range(cfg.nadmm)
+        ])
+        sh = NamedSharding(
+            self.mesh, PartitionSpec(None, None, None, CLIENT_AXIS)
+        )
+        return self._put(idx, sh)
 
     def _fetch(self, x) -> np.ndarray:
         """Device -> host, multi-host-safe.
@@ -439,11 +517,16 @@ class Trainer:
 
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
-    def evaluate(self) -> np.ndarray:
-        """Per-client top-1 accuracy over the full test set."""
+    def evaluate(self, flat=None, stats=None) -> np.ndarray:
+        """Per-client top-1 accuracy over the full test set.
+
+        `flat`/`stats` default to the trainer's live state; the fused
+        round path passes its per-consensus-round snapshots instead, so
+        the `check_results` eval cadence survives fusion.
+        """
         correct = self.eval_fn(
-            self.flat,
-            self.stats,
+            self.flat if flat is None else flat,
+            self.stats if stats is None else stats,
             self.test_imgs,
             self.test_labels,
             self.test_mask,
@@ -472,8 +555,17 @@ class Trainer:
             self._health_fn = jax.jit(
                 lambda f: jnp.isfinite(f).all(axis=tuple(range(1, f.ndim)))
             )
-        ok = self._fetch(self._health_fn(self.flat))
-        bad = np.where(~ok)[0]
+        self._check_param_flags(self._fetch(self._health_fn(self.flat)), **ctx)
+
+    def _check_param_flags(self, ok_row: np.ndarray, **ctx) -> None:
+        """`_check_params` from precomputed per-client finiteness flags.
+
+        The fused round computes the post-consensus parameter check ON
+        DEVICE for every consensus iteration (its mid-round parameters
+        never reach the host) and returns the `[nadmm, K]` flag matrix;
+        this applies the same warn/raise/rollback policy to one row.
+        """
+        bad = np.where(~np.asarray(ok_row, bool))[0]
         if bad.size:
             self.recorder.fault("nonfinite_params", bad, **ctx)
             if self.cfg.fault_mode == "raise":
@@ -488,18 +580,52 @@ class Trainer:
         The 1-D `clients` mesh assigns each device a contiguous K/D
         block of local clients (parallel/mesh.py folding); a client is
         this process' iff its device is. Single-process: all of them.
+
+        The computed ranges are ASSERTED against the sharding's own
+        `devices_indices_map` and `addressable_devices`: streaming runs
+        feed per-client host data through these ranges, so a future
+        mesh/layout change that reorders device-to-shard assignment must
+        fail loudly here rather than silently pair client c's stream
+        with client c''s device column.
         """
+        k = self.cfg.n_clients
         devs = list(self.mesh.devices.flat)
+        per = k // len(devs)
+        sh = client_sharding(self.mesh)
+        dmap = sh.devices_indices_map((k,))
+        for i, d in enumerate(devs):
+            lo, hi, _ = dmap[d][0].indices(k)
+            if (lo, hi) != (i * per, (i + 1) * per):
+                raise AssertionError(
+                    f"client sharding layout drifted: mesh device #{i} "
+                    f"({d}) holds clients [{lo}, {hi}) but the contiguous "
+                    f"K/D folding expects [{i * per}, {(i + 1) * per}) — "
+                    "the host-side client ranges (streaming feed, "
+                    "checkpoint positions) no longer match the device "
+                    "layout"
+                )
         if jax.process_count() == 1:
-            return list(range(self.cfg.n_clients))
-        per = self.cfg.n_clients // len(devs)
+            return list(range(k))
         me = jax.process_index()
-        return [
+        local = [
             c
             for i, d in enumerate(devs)
             if d.process_index == me
             for c in range(i * per, (i + 1) * per)
         ]
+        addressable = sorted(
+            c
+            for d in sh.addressable_devices
+            for c in range(*dmap[d][0].indices(k)[:2])
+        )
+        if sorted(local) != addressable:
+            raise AssertionError(
+                f"_local_clients computed {sorted(local)} but the "
+                f"sharding's addressable devices own {addressable}: the "
+                "process-to-device mapping changed under the contiguous "
+                "folding assumption"
+            )
+        return local
 
     def _run_stream_epoch(self, epoch_fn, lstate, y, z, rho):
         """One epoch through the host-streaming path, double-buffered.
@@ -603,12 +729,29 @@ class Trainer:
         arguments for the epoch program, and its own compile is seconds.
         """
         t0 = time.perf_counter()
-        epoch_fn, consensus_fn, init_fn = self._fns(gid)
         if self._stream:
             raise NotImplementedError(
                 "compile_round seeds the resident epoch program; streaming "
                 "epochs compile per-chunk shapes at first use instead"
             )
+        if self._fused_enabled():
+            # the hot program of a fused run IS the round program: lower
+            # it against the real round arguments and stop — the epoch /
+            # consensus programs would never be dispatched
+            round_fn = self._round_fn(gid)
+            lstate, y, z, rho, extra = self._init_fn(gid)(self.flat)
+            idx = self._round_indices(0, gid)
+            masks = self._put(
+                np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
+                NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
+            )
+            round_fn.lower(
+                self.flat, lstate, self.stats, self.shard_imgs,
+                self.shard_labels, idx, self.mean, self.std,
+                y, z, rho, extra, masks,
+            ).compile()
+            return time.perf_counter() - t0
+        epoch_fn, consensus_fn, init_fn = self._fns(gid)
         lstate, y, z, rho, extra = init_fn(self.flat)
         idx = self._epoch_indices(0, gid, 0, 0)
         cap = self.cfg.max_scan_steps
@@ -631,6 +774,41 @@ class Trainer:
             ).compile()
         return time.perf_counter() - t0
 
+    def _entry_snapshot(self, gid: int):
+        """Rollback-mode entry state: XLA-owned device copies.
+
+        The epoch/round fns donate flat/stats, so holding the same arrays
+        across the round would read donated buffers — but a fresh
+        XLA-owned copy (never handed to the donating fn) survives
+        donation, with no device->host round-trip (and no cross-host
+        allgather on multi-process meshes).
+        """
+        return (
+            _owned_copy(self.flat),
+            jax.tree.map(_owned_copy, self.stats),
+            _owned_copy(self._rho_store[gid])
+            if gid in self._rho_store
+            else None,
+        )
+
+    def _maybe_rollback(self, snap, nloop: int, gid: int) -> None:
+        """Transactional rollback: discard the poisoned round wholesale
+        and continue from its entry state. Everything else a round
+        produces (lstate, y, z) is re-initialized per round anyway. The
+        snapshots are XLA-owned device copies — safe to adopt directly
+        (and to be donated by the next round's epoch fn)."""
+        if not self._round_poisoned:
+            return
+        snap_flat, snap_stats, snap_rho = snap
+        self.flat = snap_flat
+        self.stats = snap_stats
+        if snap_rho is not None:
+            self._rho_store[gid] = snap_rho
+        else:
+            self._rho_store.pop(gid, None)
+        self.recorder.fault("round_rollback", [], nloop=nloop, group=gid)
+        self._round_poisoned = False
+
     def run_round(self, nloop: int, gid: int) -> None:
         """One partition group's full round: init, Nadmm x (epochs + consensus).
 
@@ -639,23 +817,20 @@ class Trainer:
         any epoch loss or post-consensus parameter goes NaN/Inf — the
         poisoned round is discarded wholesale and the run continues from
         its entry state (docs/FAULT.md).
+
+        Default path: the whole round — every epoch and every consensus
+        exchange — executes as ONE jitted program (`_run_round_fused`,
+        engine/steps.py build_round_fn). The per-dispatch paths below
+        remain for `--no-fuse-rounds` and the cases fusion cannot cover
+        (`_fused_enabled`); both produce bit-identical trajectories.
         """
+        if self._fused_enabled():
+            return self._run_round_fused(nloop, gid)
         cfg = self.cfg
         check = cfg.fault_mode != "off"
         rollback = cfg.fault_mode == "rollback"
         if rollback:
-            # DEVICE copies: the epoch fn donates flat/stats, so holding
-            # the same arrays across the round would read donated buffers
-            # — but a fresh XLA-owned copy (never handed to the epoch fn)
-            # survives donation, with no device->host round-trip (and no
-            # cross-host allgather on multi-process meshes)
-            snap_flat = _owned_copy(self.flat)
-            snap_stats = jax.tree.map(_owned_copy, self.stats)
-            snap_rho = (
-                _owned_copy(self._rho_store[gid])
-                if gid in self._rho_store
-                else None
-            )
+            snap = self._entry_snapshot(gid)
         self._round_poisoned = False
         epoch_fn, consensus_fn, init_fn = self._fns(gid)
         lstate, y, z, rho, extra = init_fn(self.flat)
@@ -748,7 +923,8 @@ class Trainer:
                     # reproduces that cadence exactly; per-epoch is the
                     # default because it keeps the epoch one computation)
                     self.recorder.accuracies(
-                        self.evaluate(), nloop=nloop, group=gid, nadmm=epoch
+                        self.evaluate(),
+                        nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch,
                     )
             if consensus_fn is not None:
                 mask = self._full_mask
@@ -822,22 +998,147 @@ class Trainer:
                 )
         if cfg.strategy == "admm":
             self._rho_store[gid] = rho
-        if rollback and self._round_poisoned:
-            # transactional rollback: discard the poisoned round wholesale
-            # and continue from its entry state. Everything else a round
-            # produces (lstate, y, z) is re-initialized per round anyway.
-            # The snapshots are XLA-owned device copies — safe to adopt
-            # directly (and to be donated by the next round's epoch fn).
-            self.flat = snap_flat
-            self.stats = snap_stats
-            if snap_rho is not None:
-                self._rho_store[gid] = snap_rho
-            else:
-                self._rho_store.pop(gid, None)
-            self.recorder.fault(
-                "round_rollback", [], nloop=nloop, group=gid
+        if rollback:
+            self._maybe_rollback(snap, nloop, gid)
+
+    def _run_round_fused(self, nloop: int, gid: int) -> None:
+        """One partition group's full round as ONE jitted dispatch.
+
+        Semantically `run_round`'s loop nest with the dispatch tail
+        harvested: the `nadmm x (nepoch + 1)` program launches collapse
+        into a single donated-carry program (steps.build_round_fn), and
+        everything the host used to do between launches moves to one
+        side or the other of it —
+
+        * epoch shuffle schedules and participation masks are precomputed
+          (`_round_indices`, injector.masks_for_round) and fed as scan
+          inputs;
+        * straggler stalls are served as one up-front stall (the
+          coordinator waiting out every slow client of the round),
+          recorded per consensus iteration as before;
+        * the loss/parameter fault checks inspect the round's outputs
+          ONCE after the dispatch — losses come back as the `[nadmm,
+          nepoch, S, K]` telemetry series anyway, and the mid-round
+          parameter finiteness arrives as on-device `[nadmm, K]` flags.
+          Rollback semantics are unchanged: the round was already
+          transactional, and a poisoned round restores the entry
+          snapshot wholesale;
+        * `check_results` evals run on the program's per-consensus-round
+          `(flat, stats)` snapshots, so the accuracy series keeps its
+          cadence; eval itself stays outside the fused program;
+        * planned crashes fire at their recorded round cursor, after the
+          dispatch — the process exits and recovery replays from the
+          checkpoint exactly as before (the device state a crashing
+          unfused run would have discarded was never observable).
+        """
+        cfg = self.cfg
+        check = cfg.fault_mode != "off"
+        rollback = cfg.fault_mode == "rollback"
+        if rollback:
+            snap = self._entry_snapshot(gid)
+        self._round_poisoned = False
+        round_fn = self._round_fn(gid)
+        lstate, y, z, rho, extra = self._init_fn(gid)(self.flat)
+        if cfg.strategy == "admm" and gid in self._rho_store:
+            rho = self._rho_store[gid]  # carry BB-adapted rho across loops
+        gsize = self.partition.group_size(gid)
+
+        idx = self._round_indices(nloop, gid)
+        masks_np = np.ones((cfg.nadmm, cfg.n_clients), np.float32)
+        # masks and straggler stalls belong to the CONSENSUS exchange —
+        # the unfused path draws them under `if consensus_fn is not None`,
+        # so independent (strategy 'none') chaos runs must not stall or
+        # record them here either
+        if self.injector is not None and cfg.strategy != "none":
+            masks_np = self.injector.masks_for_round(nloop, gid, cfg.nadmm)
+            total_delay = 0.0
+            for a, d in enumerate(
+                self.injector.straggler_delays_for_round(nloop, gid, cfg.nadmm)
+            ):
+                if d > 0:
+                    self.recorder.step_time(
+                        "straggler_wait", d, nloop=nloop, group=gid, nadmm=a
+                    )
+                    total_delay += d
+                if self.injector.will_crash(nloop, gid, a):
+                    # the unfused replay crashes at the END of iteration
+                    # `a`: its own stall is served, later iterations'
+                    # never happen — truncate so fused wall time and the
+                    # straggler_wait series match (and the resumed run,
+                    # sentinel fired, serves the full schedule like the
+                    # unfused one)
+                    break
+            if total_delay > 0:
+                time.sleep(total_delay)
+        masks = self._put(
+            masks_np,
+            NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
+        )
+
+        self._step_num += cfg.nadmm * cfg.nepoch
+        t0 = time.perf_counter()
+        with jax.profiler.StepTraceAnnotation(
+            "fused_round", step_num=self._step_num
+        ):
+            (self.flat, lstate, self.stats, y, z, rho, extra,
+             losses_d, met, param_ok_d, snaps) = round_fn(
+                self.flat, lstate, self.stats, self.shard_imgs,
+                self.shard_labels, idx, self.mean, self.std,
+                y, z, rho, extra, masks,
             )
-            self._round_poisoned = False
+            # device->host fetch of an output is the completion barrier
+            # (the telemetry series is needed host-side regardless)
+            losses = self._fetch(losses_d)  # [nadmm, nepoch, S, K]
+        self.recorder.step_time(
+            "fused_round", time.perf_counter() - t0, nloop=nloop, group=gid
+        )
+        param_ok = self._fetch(param_ok_d)  # [nadmm, K]
+        dual, primal, mean_rho, survivors = (self._fetch(m) for m in met)
+        is_admm = cfg.strategy == "admm"
+
+        # host bookkeeping replay, in the unfused path's per-round order
+        for a in range(cfg.nadmm):
+            for e in range(cfg.nepoch):
+                for s in range(losses.shape[2]):
+                    self.recorder.batch_losses(
+                        losses[a, e, s],
+                        nloop=nloop, group=gid, nadmm=a, epoch=e, minibatch=s,
+                    )
+                if check:
+                    self._check_losses(
+                        losses[a, e], nloop=nloop, group=gid, nadmm=a, epoch=e
+                    )
+            if cfg.strategy != "none":
+                self.recorder.residuals(
+                    float(primal[a]) if is_admm else None,
+                    float(dual[a]),
+                    float(mean_rho[a]) if is_admm else None,
+                    nloop=nloop, group=gid, nadmm=a, group_size=gsize,
+                )
+                if self.injector is not None:
+                    self.recorder.participation(
+                        int(survivors[a]), cfg.n_clients,
+                        nloop=nloop, group=gid, nadmm=a,
+                    )
+            if check:
+                self._check_param_flags(
+                    param_ok[a], nloop=nloop, group=gid, nadmm=a
+                )
+            if self.injector is not None:
+                self.injector.maybe_crash(nloop, gid, a)
+            if cfg.check_results:
+                flat_snaps, stats_snaps = snaps
+                self.recorder.accuracies(
+                    self.evaluate(
+                        flat=flat_snaps[a],
+                        stats=jax.tree.map(lambda x: x[a], stats_snaps),
+                    ),
+                    nloop=nloop, group=gid, nadmm=a,
+                )
+        if is_admm:
+            self._rho_store[gid] = rho
+        if rollback:
+            self._maybe_rollback(snap, nloop, gid)
 
     def run(self) -> MetricsRecorder:
         """The full experiment (all Nloop outer loops).

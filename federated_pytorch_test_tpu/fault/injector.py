@@ -47,7 +47,48 @@ class FaultInjector:
     def straggler_delay(self, nloop: int, gid: int, nadmm: int) -> float:
         return self.plan.straggler_delay(nloop, gid, nadmm)
 
+    # ------------------------------------------------- fused-round batches
+
+    def masks_for_round(self, nloop: int, gid: int, nadmm: int) -> np.ndarray:
+        """`[nadmm, K]` participation masks for a whole partition round.
+
+        The fused round program (engine/steps.py build_round_fn) consumes
+        every consensus iteration's mask as scan inputs in one dispatch;
+        each row is exactly `mask(nloop, gid, a)` — pure in the plan seed
+        and round cursor, so fused and unfused chaos runs replay the same
+        dropout schedule.
+        """
+        return np.stack(
+            [self.mask(nloop, gid, a) for a in range(nadmm)]
+        ).astype(np.float32)
+
+    def straggler_delays_for_round(
+        self, nloop: int, gid: int, nadmm: int
+    ) -> list:
+        """Per-consensus-iteration straggler delays `[nadmm]` (seconds).
+
+        A fused round is one device program, so the host cannot stall
+        BETWEEN consensus iterations; the trainer serves the round's
+        total delay in one stall (the coordinator waiting out every slow
+        client before declaring the round) while recording each
+        iteration's contribution separately for the timing series.
+        """
+        return [self.straggler_delay(nloop, gid, a) for a in range(nadmm)]
+
     # ---------------------------------------------------------- crash points
+
+    def will_crash(self, nloop: int, gid: int, nadmm: int) -> bool:
+        """Whether `maybe_crash` WOULD fire at this cursor (no side effects).
+
+        The fused round serves its straggler stalls up-front; a planned
+        crash at iteration c means the unfused replay never reaches the
+        stalls of iterations > c, so the fused path truncates there —
+        this is the query that respects the fire-once sentinels (an
+        already-fired point stalls normally on the resumed run, exactly
+        like the unfused replay).
+        """
+        point = self.plan.crash_at(nloop, gid, nadmm)
+        return point is not None and not self._already_fired(point.key())
 
     def _sentinel(self, key: str) -> Optional[str]:
         if self.state_dir is None:

@@ -818,10 +818,16 @@ def flash_attention(
     whole-sequence-resident ever sits in VMEM, forward or backward.
 
     `precision` sets the MXU pass count of every tile dot: 'highest'
-    (default) runs full-f32 passes and matches the f32 dense reference
-    to ~1e-6; 'default' runs single bf16 passes — several times faster
-    on the MXU and the standard choice for long-context training, with
-    softmax statistics and accumulators still f32.
+    (default) runs full-f32 passes; 'default' runs single bf16 passes —
+    several times faster on the MXU and the standard choice for
+    long-context training, with softmax statistics and accumulators
+    still f32. Accuracy: the ~1e-6 agreement with the f32 dense
+    reference holds for F32 INPUTS at 'highest' only. BF16 inputs are
+    input-rounding-limited at ANY precision setting: q/k/v already
+    carry bf16's ~8-bit mantissa, so expect ~2e-2 against an f32
+    reference whatever the MXU pass count — raising `precision` on bf16
+    inputs buys back only the in-kernel rounding, not the input
+    quantization (tests/test_flash.py tolerances).
 
     `block_q`/`block_k` override the VMEM tile heights (multiples of 128
     dividing S; defaults swept on a v5e — see `_BQ`). Causal uses

@@ -131,6 +131,14 @@ class LBFGSAux(NamedTuple):
     # was never evaluated (see lbfgs_step)
     aux: Any = ()
     aux_ok: jnp.ndarray | bool = True
+    # `has_aux=True` only: the user aux of the ENTRY evaluation (at the
+    # step's starting parameters; () otherwise). Always valid — the entry
+    # point is evaluated unconditionally — so it is what callers fall
+    # back to when `aux_ok` is False: the same KIND of quantity as `aux`
+    # (e.g. the engine's penalty-free data loss), one step earlier,
+    # instead of a different quantity entirely (`loss` is the total
+    # objective, penalties included).
+    entry_aux: Any = ()
 
 
 def lbfgs_init(x0: jnp.ndarray, config: LBFGSConfig) -> LBFGSState:
@@ -546,5 +554,8 @@ def lbfgs_step(
         func_evals=final.evals,
         aux=final.aux,
         aux_ok=final.aux_ok,
+        # aux0 rides along untouched by the loop; unused leaves (e.g. the
+        # engine's entry BN stats) are dead code XLA eliminates
+        entry_aux=aux0,
     )
     return final.x, new_state, aux

@@ -288,7 +288,19 @@ def test_config_rejects_invalid_enums():
 
 
 def test_step_times_recorded():
+    # fused default: the whole round is one dispatch, timed as one
+    # `fused_round` phase; the unfused path keeps the per-dispatch
+    # epoch/consensus phases
     cfg = tiny("fedavg", model="net", nadmm=1)
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    tr.group_order = tr.group_order[:1]
+    rec = tr.run()
+    times = rec.series["step_time"]
+    phases = {t["value"]["phase"] for t in times}
+    assert phases == {"fused_round"}
+    assert all(t["value"]["seconds"] > 0 for t in times)
+
+    cfg = tiny("fedavg", model="net", nadmm=1, fuse_rounds=False)
     tr = Trainer(cfg, verbose=False, source=SRC)
     tr.group_order = tr.group_order[:1]
     rec = tr.run()

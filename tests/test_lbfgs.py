@@ -314,3 +314,38 @@ def test_compact_vs_two_loop_end_to_end():
         np.testing.assert_allclose(xs["compact"], xs["two_loop"], rtol=1e-8)
     finally:
         jax.config.update("jax_enable_x64", False)
+
+
+def test_has_aux_entry_aux_is_the_entry_evaluation():
+    # LBFGSAux.entry_aux carries the user aux of the ENTRY evaluation —
+    # what callers fall back to when the NaN-step fallback leaves
+    # `aux_ok` False. Without it the engine's folded diagnostic forward
+    # reported the entry OBJECTIVE (penalties included) on fallback
+    # steps while the explicit path reports penalty-free data loss: two
+    # meanings in one train_loss series (ISSUE 2 satellite).
+    cfg = LBFGSConfig(
+        max_iter=3, history_size=4, line_search=True, batch_mode=True
+    )
+
+    def loss_aux(x):
+        data = jnp.sum((x - 1.0) ** 2)
+        penalty = 7.0 + jnp.sum(x**2)  # stands in for elastic-net/ADMM
+        return data + penalty, (data, x * 2.0)
+
+    x0 = jnp.asarray(np.r_[0.4, -0.3, 2.0], jnp.float32)
+    state = lbfgs_init(x0, cfg)
+    x1, _, aux = lbfgs_step(loss_aux, x0, state, cfg, has_aux=True)
+
+    entry_data, entry_extra = aux.entry_aux
+    np.testing.assert_allclose(
+        float(entry_data), float(jnp.sum((x0 - 1.0) ** 2)), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(entry_extra), np.asarray(x0) * 2.0, rtol=1e-6
+    )
+    # entry aux is NOT the final-point aux (the step moved), and is NOT
+    # the total objective (the penalty stays out of it)
+    final_data, _ = aux.aux
+    assert bool(aux.aux_ok)
+    assert float(final_data) < float(entry_data)
+    assert abs(float(entry_data) - float(aux.loss)) > 1.0  # loss includes penalty
