@@ -3,7 +3,8 @@
 The FINAL stdout line is ONE compact JSON headline (the driver parses
 the last line of a bounded stdout tail, so it must stay short):
   {"metric": ..., "value": N, "unit": "samples/sec", "sps_p25": N,
-   "sps_p75": N, "vs_baseline": N, "mfu": ..., "mxu_pct_peak": ...}
+   "sps_p75": N, "vs_baseline": N, "mfu": ..., "mxu_pct_peak": ...,
+   "comm_bytes_per_round": N, "comm_savings_vs_full": N}
 `value` is the MEDIAN of `BENCH_REPEATS` (default 5) timed runs with
 its p25/p75 dispersion alongside — the chip is shared and single draws
 range 160-2600 samples/s on the flagship (BASELINE.md), so a best-of-N
@@ -105,6 +106,20 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
     cfg = get_preset(preset, **over)
     tr = Trainer(cfg, verbose=False, source=src)
     gid = tr.group_order[0]
+
+    # exact communication cost of the measured workload (obs/ledger.py):
+    # bytes one consensus exchange of the measured group moves at full
+    # participation, and how many times more the whole-model exchange
+    # over one partition sweep would move — the paper's bandwidth claim
+    # as a benchmark artifact, derived from the static Partition spec
+    from federated_pytorch_test_tpu.obs import CommLedger
+
+    ledger = CommLedger(
+        tr.partition, k, dtype_bytes=int(jnp.dtype(tr.flat.dtype).itemsize)
+    )
+    comm_bytes_per_round = ledger.round_bytes(gid, k)
+    comm_savings_vs_full = round(ledger.savings_vs_full(tr.group_order), 2)
+
     epoch_fn, _, init_fn = tr._fns(gid)
     lstate, y, z, rho, extra = init_fn(tr.flat)
     flat, stats = tr.flat, tr.stats
@@ -163,6 +178,8 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
         "sps_p25": round(n_samples / dt_p75, 2),
         "sps_p75": round(n_samples / dt_p25, 2),
         "epoch_time_s": round(dt, 4),
+        "comm_bytes_per_round": comm_bytes_per_round,
+        "comm_savings_vs_full": comm_savings_vs_full,
     }
     if flops:
         row["achieved_tflops"] = round(flops / dt / 1e12, 3)
@@ -390,6 +407,12 @@ def main() -> None:
         "dtype": out["dtype"],
         "mfu": out.get("mfu"),
         "epoch_time_s": out["roofline"]["epoch_time_s"],
+        # the communication ledger's two headline facts (obs/ledger.py):
+        # exact bytes one consensus exchange of the measured group moves,
+        # and the partial-vs-full-model exchange savings over a partition
+        # sweep — the quantity the source paper's bandwidth claim is about
+        "comm_bytes_per_round": flag.get("comm_bytes_per_round"),
+        "comm_savings_vs_full": flag.get("comm_savings_vs_full"),
     }
     if "mxu_probe" in out:
         headline["mxu_pct_peak"] = out["mxu_probe"]["pct_peak"]

@@ -21,6 +21,16 @@ path) injects replayable dropout/straggler/crash faults, and
 `--resume auto --save-model` makes a crashed run recover from the latest
 readable checkpoint on restart. An injected crash exits non-zero with
 the InjectedCrash message; rerunning the identical command resumes.
+
+Observability (obs/, docs/OBSERVABILITY.md) rides it too:
+`--metrics-stream run.jsonl` streams every metric record to a crash-safe
+JSONL file that `--resume auto` continues seamlessly, `--trace-out
+run.trace.json` writes the host loop nest as Chrome trace-event JSON
+(open in https://ui.perfetto.dev), `--diagnostics-every N` samples the
+cross-client `group_distance` diagnostic, and every run ends with a
+summary table: per-series record counts, exact communicated bytes vs the
+full-model-exchange and ship-the-data baselines, dispatch and recompile
+counts.
 """
 
 from __future__ import annotations
@@ -57,6 +67,55 @@ def _add_config_flags(parser: argparse.ArgumentParser) -> None:
             parser.add_argument(flag, dest=f.name, type=typ, default=None)
 
 
+def _print_summary(recorder, cfg) -> None:
+    """End-of-run observability summary (one `#`-prefixed line each)."""
+    counts = ", ".join(
+        f"{name}={len(recs)}" for name, recs in sorted(recorder.series.items())
+    )
+    print(f"# series: {counts}")
+    comm = recorder.latest("comm_summary")
+    if comm and comm.get("rounds"):
+        line = (
+            f"# comm: {comm['bytes_total']:,} B uplink over "
+            f"{comm['rounds']} consensus rounds "
+            f"({comm['bytes_per_round_mean']:,.0f} B/round); "
+            f"full-model exchange would be {comm['bytes_full_exchange']:,} B"
+        )
+        if comm.get("savings_vs_full") is not None:
+            # None when total uplink is zero (every round fully dropped)
+            line += f" (savings x{comm['savings_vs_full']})"
+        if comm.get("data_floor_bytes"):
+            line += (
+                f"; ship-the-data floor {comm['data_floor_bytes']:,} B "
+                f"(uplink/floor {comm['vs_data_floor']})"
+            )
+        print(line)
+    disp: dict = {}
+    for r in recorder.series.get("dispatch_count", []):
+        for k, v in r["value"].items():
+            disp[k] = disp.get(k, 0) + v
+    recompiles = sum(
+        r["value"] for r in recorder.series.get("recompile_count", [])
+    )
+    if disp:
+        per_cat = ", ".join(
+            f"{k}={v}" for k, v in sorted(disp.items()) if k != "total"
+        )
+        print(
+            f"# dispatches: {disp.get('total', 0)} ({per_cat}); "
+            f"compiled programs: {recompiles}"
+        )
+    if cfg.metrics_stream:
+        print(f"# metric stream: {cfg.metrics_stream}")
+    if cfg.trace_out:
+        print(
+            f"# trace: {cfg.trace_out} (open in https://ui.perfetto.dev "
+            "or chrome://tracing)"
+        )
+    if recorder.first_nonfinite is not None:
+        print(f"# FIRST NON-FINITE at {recorder.first_nonfinite}")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="federated_pytorch_test_tpu",
@@ -70,7 +129,11 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--list-presets", action="store_true")
     parser.add_argument(
-        "--metrics-out", default=None, help="write metric series JSON here"
+        "--metrics-out",
+        default=None,
+        help="write the final metrics JSON here (atomic write; envelope "
+        '{"series": ..., "first_nonfinite": ...}). For an incremental '
+        "stream that survives crashes, use --metrics-stream instead.",
     )
     parser.add_argument("--quiet", action="store_true")
     _add_config_flags(parser)
@@ -95,6 +158,7 @@ def main(argv=None) -> int:
     if args.metrics_out:
         recorder.save(args.metrics_out)
         print(f"# metrics written to {args.metrics_out}")
+    _print_summary(recorder, cfg)
     final = recorder.latest("test_accuracy")
     if final is not None:
         print("# final per-client accuracy: " + json.dumps(final))

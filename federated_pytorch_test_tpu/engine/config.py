@@ -172,6 +172,24 @@ class ExperimentConfig:
     # write a jax.profiler trace of each epoch here (TPU/host timelines)
     profile_dir: str | None = None
 
+    # --- observability (obs/, docs/OBSERVABILITY.md) ---
+    # crash-safe append-only JSONL metric stream: every record is written
+    # as it is logged and committed at checkpoint boundaries; with
+    # resume='auto' a crashed run's stream is truncated to the restore
+    # point and continued, so the series is identical to an uninterrupted
+    # run's (obs/sinks.py JsonlSink). None = in-memory metrics only.
+    metrics_stream: str | None = None
+    # write a Chrome trace-event JSON of the host-side loop nest here
+    # (round/epoch/consensus/eval/compile spans — open in
+    # https://ui.perfetto.dev); complements profile_dir's device
+    # timelines (obs/trace.py TraceRecorder)
+    trace_out: str | None = None
+    # record the `group_distance` diagnostic series every N partition
+    # rounds (parallel/diagnostics.py group_distances — the reference's
+    # never-called distance_of_layers, given a cadence). None = off; the
+    # diagnostic is one extra tiny jitted dispatch per sampled round.
+    diagnostics_every: int | None = None
+
     # failure detection (SURVEY.md §5 — absent in the reference): check
     # per-client losses each epoch and per-client parameter finiteness
     # each consensus round. 'warn' records a `fault` metric and continues
@@ -256,6 +274,10 @@ class ExperimentConfig:
         if self.max_scan_steps is not None and self.max_scan_steps < 1:
             raise ValueError(
                 f"max_scan_steps must be >= 1, got {self.max_scan_steps}"
+            )
+        if self.diagnostics_every is not None and self.diagnostics_every < 1:
+            raise ValueError(
+                f"diagnostics_every must be >= 1, got {self.diagnostics_every}"
             )
 
     def lbfgs_config(self) -> LBFGSConfig:

@@ -304,7 +304,18 @@ def _client_train_step(ctx: GroupContext):
     return step
 
 
-def build_epoch_fn(ctx: GroupContext, mesh):
+def _counted(fn, counter, category: str):
+    """Wrap a built program in the dispatch-counting proxy (obs/trace.py).
+
+    The builders are the one place that knows what KIND of program was
+    built, so the `dispatch_count` series' categories are tagged here;
+    `counter=None` (benchmarks, tests poking builders directly) returns
+    the bare jitted fn.
+    """
+    return fn if counter is None or fn is None else counter.wrap(fn, category)
+
+
+def build_epoch_fn(ctx: GroupContext, mesh, counter=None):
     """Jitted epoch: scan over minibatches, vmap over local clients.
 
     Signature:
@@ -354,10 +365,10 @@ def build_epoch_fn(ctx: GroupContext, mesh):
     )
     # params/opt-state/batch-stats are consumed and re-emitted every epoch:
     # donate them so XLA updates in place instead of double-buffering
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return _counted(jax.jit(sharded, donate_argnums=(0, 1, 2)), counter, "epoch")
 
 
-def build_stream_epoch_fn(ctx: GroupContext, mesh):
+def build_stream_epoch_fn(ctx: GroupContext, mesh, counter=None):
     """Jitted epoch CHUNK for the host-streaming data path.
 
     Like `build_epoch_fn` but the minibatches arrive pre-assembled as
@@ -406,10 +417,10 @@ def build_stream_epoch_fn(ctx: GroupContext, mesh):
     )
     # donate params/opt-state/stats as in build_epoch_fn; the image chunk
     # is NOT donated (the host reuses its staging buffer)
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return _counted(jax.jit(sharded, donate_argnums=(0, 1, 2)), counter, "epoch")
 
 
-def build_round_init_fn(ctx: GroupContext, mesh):
+def build_round_init_fn(ctx: GroupContext, mesh, counter=None):
     """Fresh per-group optimizer + consensus state from current params.
 
     The reference creates a fresh `LBFGSNew` per partition round
@@ -440,7 +451,7 @@ def build_round_init_fn(ctx: GroupContext, mesh):
         out_specs=(c, c, P(), c, (c, c)),
         check_vma=True,
     )
-    return jax.jit(sharded)
+    return _counted(jax.jit(sharded), counter, "round_init")
 
 
 def _consensus_local(ctx: GroupContext):
@@ -493,7 +504,7 @@ def _consensus_local(ctx: GroupContext):
     return local
 
 
-def build_consensus_fn(ctx: GroupContext, mesh):
+def build_consensus_fn(ctx: GroupContext, mesh, counter=None):
     """Jitted averaging/ADMM round over the active group's coordinates.
 
     FedAvg: z = mean_k x_k, broadcast back into every client's params
@@ -523,7 +534,7 @@ def build_consensus_fn(ctx: GroupContext, mesh):
     )
     # no donation here: the round-init placeholders alias buffers (e.g.
     # the fedavg extra=(y, y)) and these arrays are one group wide anyway
-    return jax.jit(sharded)
+    return _counted(jax.jit(sharded), counter, "consensus")
 
 
 def build_round_fn(
@@ -533,6 +544,7 @@ def build_round_fn(
     nadmm: int,
     nepoch: int,
     snapshot: bool = False,
+    counter=None,
 ):
     """One partition group's FULL averaging round as ONE jitted program.
 
@@ -671,10 +683,12 @@ def build_round_fn(
     # are NOT donated — the round-init placeholders alias buffers (e.g.
     # the fedavg extra=(y, y)), same reason build_consensus_fn never
     # donates.
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return _counted(
+        jax.jit(sharded, donate_argnums=(0, 1, 2)), counter, "round"
+    )
 
 
-def build_eval_fn(model, unravel, has_stats: bool, mesh):
+def build_eval_fn(model, unravel, has_stats: bool, mesh, counter=None):
     """Jitted full-test-set evaluation for every client.
 
     The reference's `verification_error_check` iterates each client's
@@ -720,4 +734,4 @@ def build_eval_fn(model, unravel, has_stats: bool, mesh):
         out_specs=c,
         check_vma=True,
     )
-    return jax.jit(sharded)
+    return _counted(jax.jit(sharded), counter, "eval")

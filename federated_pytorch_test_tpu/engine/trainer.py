@@ -16,6 +16,8 @@ bit for bit.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import time
 from typing import Any, Dict, Optional
 
@@ -36,6 +38,12 @@ from federated_pytorch_test_tpu.engine.steps import (
 )
 from federated_pytorch_test_tpu.fault import FaultInjector, FaultPlan
 from federated_pytorch_test_tpu.models import MODELS
+from federated_pytorch_test_tpu.obs import (
+    CommLedger,
+    DispatchCounter,
+    JsonlSink,
+    TraceRecorder,
+)
 from jax.sharding import NamedSharding, PartitionSpec
 
 from federated_pytorch_test_tpu.parallel import (
@@ -44,6 +52,7 @@ from federated_pytorch_test_tpu.parallel import (
     largest_feasible_mesh,
     mesh_size,
     replicated_sharding,
+    shard_map,
 )
 from federated_pytorch_test_tpu.partition import (
     Partition,
@@ -290,6 +299,21 @@ class Trainer:
             np.ones(cfg.n_clients, np.float32), csh
         )
 
+        # observability (obs/, docs/OBSERVABILITY.md): dispatch/recompile
+        # counting, the communication-volume ledger, and host-side trace
+        # spans. The JSONL metric sink attaches AFTER the restore below —
+        # its truncation point is the restored loop cursor.
+        self._dispatch = DispatchCounter()
+        self._diag_fn = None  # jitted group_distances, built on first use
+        self._comm = CommLedger(
+            self.partition,
+            cfg.n_clients,
+            dtype_bytes=int(jnp.dtype(self.flat.dtype).itemsize),
+            data_floor_bytes=int(data_bytes),
+        )
+        if cfg.trace_out and jax.process_index() == 0:
+            self.recorder.tracer = TraceRecorder()
+
         if cfg.load_model or cfg.resume == "auto":
             try:
                 self._restore()
@@ -298,6 +322,43 @@ class Trainer:
                     raise  # load_model REQUIRES a checkpoint; resume=auto
                     # starts fresh when none exists (first run of a chaos
                     # experiment, or every checkpoint was torn)
+        # partition rounds already accounted for (diagnostics cadence):
+        # derived from the restored cursor, not process history, so a
+        # resumed run samples group_distance at the same global rounds an
+        # uninterrupted one does
+        self._rounds_done = self._completed_nloops * len(self.group_order)
+        if cfg.metrics_stream and jax.process_index() == 0:
+            # single-writer like the checkpoints: on a multi-process mesh
+            # every process records identical series (metrics come off
+            # allgathered values), so process 0's stream is THE stream
+            sink = JsonlSink(cfg.metrics_stream, tag=self._stream_tag())
+            replay = sink.open(
+                resume_nloops=self._completed_nloops
+                if cfg.resume == "auto"
+                else None
+            )
+            self.recorder.add_sink(sink, replay=replay)
+            # replayed rounds will not re-run: seed the ledger's totals
+            # so the end-of-run comm summary covers the whole run
+            self._comm.absorb(self.recorder.series.get("comm_bytes", []))
+        if (
+            self._completed_nloops
+            and cfg.strategy != "none"
+            and not self.recorder.series.get("comm_bytes")
+        ):
+            # resumed WITHOUT a stream to absorb (no metrics_stream, or
+            # the stream was abandoned): the skipped loops' traffic is
+            # still exactly recomputable — masks are pure in (plan seed,
+            # round cursor) — so the comm summary covers the whole run
+            for nloop in range(self._completed_nloops):
+                for gid in self.group_order:
+                    for a in range(cfg.nadmm):
+                        surv = (
+                            int(self.injector.mask(nloop, gid, a).sum())
+                            if self.injector is not None
+                            else cfg.n_clients
+                        )
+                        self._comm.account(gid, surv)
         if cfg.average_model:
             # one-shot whole-model average before training
             # (reference src/no_consensus_trio.py:22,134-160)
@@ -323,6 +384,30 @@ class Trainer:
                 ))
 
     # ---------------------------------------------------------------- setup
+
+    def _stream_tag(self) -> str:
+        """Identity stamp of the JSONL metric stream's header line.
+
+        A resumed run must only splice onto a stream written by the SAME
+        experiment, so the tag digests the WHOLE config (any knob —
+        nepoch, batch, strategy, model_kwargs... — changes the series)
+        except the pure output paths, plus the parsed fault plan's digest
+        (fault/injector.py plan_tag — `fault_plan` may be a file path
+        whose contents changed). A mismatch costs a fresh stream with a
+        warning; a silent splice of two experiments would be worse.
+        """
+        d = dataclasses.asdict(self.cfg)
+        # excluded: pure output paths, and `resume` — the recovery switch
+        # is exactly the knob a restarted run flips, and the trajectory it
+        # continues is guarded by the checkpoint-marker alignment, not by
+        # config identity
+        for k in ("metrics_stream", "trace_out", "profile_dir", "resume"):
+            d.pop(k, None)
+        cfg_tag = hashlib.md5(
+            json.dumps(d, sort_keys=True, default=repr).encode()
+        ).hexdigest()[:8]
+        plan = self.injector.plan_tag if self.injector is not None else "noplan"
+        return f"{self.cfg.name}:seed{self.cfg.seed}:cfg{cfg_tag}:{plan}"
 
     def _init_variables(self) -> PyTree:
         """Stacked client variables.
@@ -399,14 +484,17 @@ class Trainer:
         if gid not in self._epoch_fns:
             ctx = self._ctx(gid)
             builder = build_stream_epoch_fn if self._stream else build_epoch_fn
-            self._epoch_fns[gid] = builder(ctx, self.mesh)
-            self._consensus_fns[gid] = build_consensus_fn(ctx, self.mesh)
-            self._init_fns[gid] = build_round_init_fn(ctx, self.mesh)
+            c = self._dispatch
+            self._epoch_fns[gid] = builder(ctx, self.mesh, counter=c)
+            self._consensus_fns[gid] = build_consensus_fn(ctx, self.mesh, counter=c)
+            self._init_fns[gid] = build_round_init_fn(ctx, self.mesh, counter=c)
         return self._epoch_fns[gid], self._consensus_fns[gid], self._init_fns[gid]
 
     def _init_fn(self, gid: int):
         if gid not in self._init_fns:
-            self._init_fns[gid] = build_round_init_fn(self._ctx(gid), self.mesh)
+            self._init_fns[gid] = build_round_init_fn(
+                self._ctx(gid), self.mesh, counter=self._dispatch
+            )
         return self._init_fns[gid]
 
     def _fused_enabled(self) -> bool:
@@ -449,6 +537,7 @@ class Trainer:
                 # mid-round state only needs materializing when the
                 # per-consensus-round eval cadence will read it
                 snapshot=self.cfg.check_results,
+                counter=self._dispatch,
             )
         return self._round_fns[gid]
 
@@ -456,7 +545,8 @@ class Trainer:
     def eval_fn(self):
         if self._eval_fn is None:
             self._eval_fn = build_eval_fn(
-                self.model, self.unravel, self.has_stats, self.mesh
+                self.model, self.unravel, self.has_stats, self.mesh,
+                counter=self._dispatch,
             )
         return self._eval_fn
 
@@ -524,17 +614,18 @@ class Trainer:
         round path passes its per-consensus-round snapshots instead, so
         the `check_results` eval cadence survives fusion.
         """
-        correct = self.eval_fn(
-            self.flat if flat is None else flat,
-            self.stats if stats is None else stats,
-            self.test_imgs,
-            self.test_labels,
-            self.test_mask,
-            self.mean,
-            self.std,
-        )
-        total = int(np.asarray(self.test_mask).sum())  # replicated: local
-        return self._fetch(correct) / total
+        with self.recorder.phase("eval", record=False):
+            correct = self.eval_fn(
+                self.flat if flat is None else flat,
+                self.stats if stats is None else stats,
+                self.test_imgs,
+                self.test_labels,
+                self.test_mask,
+                self.mean,
+                self.std,
+            )
+            total = int(np.asarray(self.test_mask).sum())  # replicated: local
+            return self._fetch(correct) / total
 
     def _check_losses(self, losses: np.ndarray, **ctx) -> None:
         """Per-epoch failure detection: a client whose losses went
@@ -552,8 +643,11 @@ class Trainer:
     def _check_params(self, **ctx) -> None:
         """Per-round failure detection: per-client parameter finiteness."""
         if self._health_fn is None:
-            self._health_fn = jax.jit(
-                lambda f: jnp.isfinite(f).all(axis=tuple(range(1, f.ndim)))
+            self._health_fn = self._dispatch.wrap(
+                jax.jit(
+                    lambda f: jnp.isfinite(f).all(axis=tuple(range(1, f.ndim)))
+                ),
+                "health",
             )
         self._check_param_flags(self._fetch(self._health_fn(self.flat)), **ctx)
 
@@ -734,45 +828,46 @@ class Trainer:
                 "compile_round seeds the resident epoch program; streaming "
                 "epochs compile per-chunk shapes at first use instead"
             )
-        if self._fused_enabled():
-            # the hot program of a fused run IS the round program: lower
-            # it against the real round arguments and stop — the epoch /
-            # consensus programs would never be dispatched
-            round_fn = self._round_fn(gid)
-            lstate, y, z, rho, extra = self._init_fn(gid)(self.flat)
-            idx = self._round_indices(0, gid)
-            masks = self._put(
-                np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
-                NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
-            )
-            round_fn.lower(
-                self.flat, lstate, self.stats, self.shard_imgs,
-                self.shard_labels, idx, self.mean, self.std,
-                y, z, rho, extra, masks,
-            ).compile()
+        with self.recorder.phase("compile", record=False, group=gid):
+            if self._fused_enabled():
+                # the hot program of a fused run IS the round program:
+                # lower it against the real round arguments and stop —
+                # the epoch / consensus programs would never be dispatched
+                round_fn = self._round_fn(gid)
+                lstate, y, z, rho, extra = self._init_fn(gid)(self.flat)
+                idx = self._round_indices(0, gid)
+                masks = self._put(
+                    np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
+                    NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
+                )
+                round_fn.lower(
+                    self.flat, lstate, self.stats, self.shard_imgs,
+                    self.shard_labels, idx, self.mean, self.std,
+                    y, z, rho, extra, masks,
+                ).compile()
+                return time.perf_counter() - t0
+            epoch_fn, consensus_fn, init_fn = self._fns(gid)
+            lstate, y, z, rho, extra = init_fn(self.flat)
+            idx = self._epoch_indices(0, gid, 0, 0)
+            cap = self.cfg.max_scan_steps
+            slices = [idx]
+            if cap is not None and idx.shape[0] > cap:
+                # chunked epochs execute [cap, K, B] slices plus one
+                # remainder slice — both shapes must be seeded or the warm
+                # run still pays a cold compile on the tail
+                slices = [idx[:cap]]
+                if idx.shape[0] % cap:
+                    slices.append(idx[: idx.shape[0] % cap])
+            for sl in slices:
+                epoch_fn.lower(
+                    self.flat, lstate, self.stats, self.shard_imgs,
+                    self.shard_labels, sl, self.mean, self.std, y, z, rho,
+                ).compile()
+            if consensus_fn is not None:
+                consensus_fn.lower(
+                    self.flat, y, z, rho, extra, jnp.int32(0), self._full_mask
+                ).compile()
             return time.perf_counter() - t0
-        epoch_fn, consensus_fn, init_fn = self._fns(gid)
-        lstate, y, z, rho, extra = init_fn(self.flat)
-        idx = self._epoch_indices(0, gid, 0, 0)
-        cap = self.cfg.max_scan_steps
-        slices = [idx]
-        if cap is not None and idx.shape[0] > cap:
-            # chunked epochs execute [cap, K, B] slices plus one remainder
-            # slice — both shapes must be seeded or the warm run still
-            # pays a cold compile on the tail
-            slices = [idx[:cap]]
-            if idx.shape[0] % cap:
-                slices.append(idx[: idx.shape[0] % cap])
-        for sl in slices:
-            epoch_fn.lower(
-                self.flat, lstate, self.stats, self.shard_imgs,
-                self.shard_labels, sl, self.mean, self.std, y, z, rho,
-            ).compile()
-        if consensus_fn is not None:
-            consensus_fn.lower(
-                self.flat, y, z, rho, extra, jnp.int32(0), self._full_mask
-            ).compile()
-        return time.perf_counter() - t0
 
     def _entry_snapshot(self, gid: int):
         """Rollback-mode entry state: XLA-owned device copies.
@@ -820,12 +915,82 @@ class Trainer:
 
         Default path: the whole round — every epoch and every consensus
         exchange — executes as ONE jitted program (`_run_round_fused`,
-        engine/steps.py build_round_fn). The per-dispatch paths below
-        remain for `--no-fuse-rounds` and the cases fusion cannot cover
-        (`_fused_enabled`); both produce bit-identical trajectories.
+        engine/steps.py build_round_fn). The per-dispatch paths of
+        `_run_round_unfused` remain for `--no-fuse-rounds` and the cases
+        fusion cannot cover (`_fused_enabled`); both produce bit-identical
+        trajectories.
+
+        This wrapper is the round's observability boundary (obs/): one
+        trace span covering the round, per-round `dispatch_count` /
+        `recompile_count` deltas, the `--diagnostics-every` cadence, and
+        the per-round sink flush. An injected crash skips the per-round
+        counters (their round never completed; the resumed run re-records
+        it) but still flushes, so the crashed stream holds everything the
+        round logged.
         """
-        if self._fused_enabled():
-            return self._run_round_fused(nloop, gid)
+        before = self._dispatch.snapshot()
+        compiled_before = self._dispatch.compiled_programs()
+        try:
+            with self.recorder.phase("round", record=False, nloop=nloop, group=gid):
+                if self._fused_enabled():
+                    self._run_round_fused(nloop, gid)
+                else:
+                    self._run_round_unfused(nloop, gid)
+        finally:
+            self.recorder.flush()
+        self._rounds_done += 1
+        # the diagnostics sample runs BEFORE the delta is taken, so its
+        # dispatch (and first-use compile) land in THIS round's
+        # dispatch_count/recompile_count instead of falling between
+        # every delta window
+        every = self.cfg.diagnostics_every
+        if every is not None and self._rounds_done % every == 0:
+            self._record_group_distances(nloop, gid)
+        self.recorder.log(
+            "dispatch_count",
+            self._dispatch.delta_since(before),
+            nloop=nloop,
+            group=gid,
+        )
+        # recompiles are PROCESS-local (a resumed run recompiles programs
+        # the crashed one had warm): kept out of the stream (stream=False)
+        self.recorder.log(
+            "recompile_count",
+            self._dispatch.compiled_programs() - compiled_before,
+            stream=False,
+            nloop=nloop,
+            group=gid,
+        )
+        if self.recorder.tracer is not None:
+            self.recorder.tracer.counter("dispatches", self._dispatch.counts)
+        self.recorder.flush()
+
+    def _record_group_distances(self, nloop: int, gid: int) -> None:
+        """Sample `parallel/diagnostics.py group_distances` into the
+        `group_distance` series: per-group mean distance of each client's
+        parameters from the cross-client mean, at the current `flat`."""
+        if self._diag_fn is None:
+            from federated_pytorch_test_tpu.parallel.diagnostics import (
+                group_distances,
+            )
+
+            part = self.partition
+            self._diag_fn = self._dispatch.wrap(
+                jax.jit(
+                    shard_map(
+                        lambda xl: group_distances(xl, part),
+                        mesh=self.mesh,
+                        in_specs=PartitionSpec(CLIENT_AXIS),
+                        out_specs=PartitionSpec(),
+                    )
+                ),
+                "diagnostics",
+            )
+        dists = self._fetch(self._diag_fn(self.flat))
+        self.recorder.group_distance(dists, nloop=nloop, group=gid)
+
+    def _run_round_unfused(self, nloop: int, gid: int) -> None:
+        """`run_round`'s per-dispatch path (see its docstring)."""
         cfg = self.cfg
         check = cfg.fault_mode != "off"
         rollback = cfg.fault_mode == "rollback"
@@ -848,8 +1013,9 @@ class Trainer:
                 )
                 self._step_num += 1
                 per_batch_eval = cfg.check_results and cfg.eval_every_batch
-                t0 = time.perf_counter()
-                with jax.profiler.StepTraceAnnotation(
+                with self.recorder.phase(
+                    "epoch", nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch
+                ), jax.profiler.StepTraceAnnotation(
                     "epoch", step_num=self._step_num
                 ):
                     if self._stream:
@@ -891,14 +1057,6 @@ class Trainer:
                         lstate, losses = self._run_resident_epoch(
                             epoch_fn, lstate, y, z, rho, idx
                         )  # [S, K]
-                self.recorder.step_time(
-                    "epoch",
-                    time.perf_counter() - t0,
-                    nloop=nloop,
-                    group=gid,
-                    nadmm=nadmm,
-                    epoch=epoch,
-                )
                 for s in range(losses.shape[0]):
                     self.recorder.batch_losses(
                         losses[s],
@@ -947,21 +1105,15 @@ class Trainer:
                         mask = self._put(
                             m_np, client_sharding(self.mesh)
                         )
-                t0 = time.perf_counter()
-                with jax.profiler.TraceAnnotation("consensus"):
+                with self.recorder.phase(
+                    "consensus", nloop=nloop, group=gid, nadmm=nadmm
+                ), jax.profiler.TraceAnnotation("consensus"):
                     self.flat, y, z, rho, extra, met = consensus_fn(
                         self.flat, y, z, rho, extra, jnp.int32(nadmm), mask
                     )
                     dual, primal, mean_rho, survivors = (
                         self._fetch(m) for m in met
                     )
-                self.recorder.step_time(
-                    "consensus",
-                    time.perf_counter() - t0,
-                    nloop=nloop,
-                    group=gid,
-                    nadmm=nadmm,
-                )
                 is_admm = cfg.strategy == "admm"
                 self.recorder.residuals(
                     primal if is_admm else None,
@@ -980,6 +1132,11 @@ class Trainer:
                         group=gid,
                         nadmm=nadmm,
                     )
+                # exact communicated bytes of this exchange (obs/ledger.py):
+                # the active group's coordinates, participating clients only
+                self._comm.record(
+                    self.recorder, gid, int(survivors), nloop=nloop, nadmm=nadmm
+                )
             if check:
                 self._check_params(nloop=nloop, group=gid, nadmm=nadmm)
             if self.injector is not None:
@@ -1076,8 +1233,9 @@ class Trainer:
         )
 
         self._step_num += cfg.nadmm * cfg.nepoch
-        t0 = time.perf_counter()
-        with jax.profiler.StepTraceAnnotation(
+        with self.recorder.phase(
+            "fused_round", nloop=nloop, group=gid
+        ), jax.profiler.StepTraceAnnotation(
             "fused_round", step_num=self._step_num
         ):
             (self.flat, lstate, self.stats, y, z, rho, extra,
@@ -1089,9 +1247,6 @@ class Trainer:
             # device->host fetch of an output is the completion barrier
             # (the telemetry series is needed host-side regardless)
             losses = self._fetch(losses_d)  # [nadmm, nepoch, S, K]
-        self.recorder.step_time(
-            "fused_round", time.perf_counter() - t0, nloop=nloop, group=gid
-        )
         param_ok = self._fetch(param_ok_d)  # [nadmm, K]
         dual, primal, mean_rho, survivors = (self._fetch(m) for m in met)
         is_admm = cfg.strategy == "admm"
@@ -1120,6 +1275,11 @@ class Trainer:
                         int(survivors[a]), cfg.n_clients,
                         nloop=nloop, group=gid, nadmm=a,
                     )
+                # same comm accounting as the unfused path, one record per
+                # consensus iteration of the fused scan (obs/ledger.py)
+                self._comm.record(
+                    self.recorder, gid, int(survivors[a]), nloop=nloop, nadmm=a
+                )
             if check:
                 self._check_param_flags(
                     param_ok[a], nloop=nloop, group=gid, nadmm=a
@@ -1147,11 +1307,33 @@ class Trainer:
         jax.profiler trace (device + host timelines, viewable in
         TensorBoard/Perfetto) — the tracing subsystem the reference lacks
         (SURVEY.md §5: a dead `start_time=time.time()` is all it has).
+        `cfg.trace_out` is the complementary HOST-side trace: the loop
+        nest's round/epoch/consensus/eval/compile spans as Chrome
+        trace-event JSON (obs/trace.py), written even when the run dies on
+        an injected crash so the chaos timeline survives for post-mortem.
         """
-        if self.cfg.profile_dir:
-            with jax.profiler.trace(self.cfg.profile_dir):
-                return self._run_impl()
-        return self._run_impl()
+        try:
+            if self.cfg.profile_dir:
+                with jax.profiler.trace(self.cfg.profile_dir):
+                    return self._run_impl()
+            return self._run_impl()
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Flush and close the observability outputs (idempotent): write
+        the Chrome trace atomically, flush and close the metric sinks."""
+        if self.recorder.tracer is not None and self.cfg.trace_out:
+            try:
+                self.recorder.tracer.save(self.cfg.trace_out)
+            except Exception as e:  # close() runs in run()'s finally: a
+                # failed trace write (read-only dir, unserializable span
+                # arg) must not mask the run's own outcome (incl. an
+                # InjectedCrash) nor skip the sink close below
+                import warnings
+
+                warnings.warn(f"could not write trace {self.cfg.trace_out}: {e}")
+        self.recorder.close()
 
     def _run_impl(self) -> MetricsRecorder:
         cfg = self.cfg
@@ -1159,10 +1341,21 @@ class Trainer:
             for gid in self.group_order:
                 self.run_round(nloop, gid)
             self._completed_nloops = nloop + 1
+            # stream durability barrier, BEFORE the checkpoint write: a
+            # crash between the two leaves the stream AHEAD of the
+            # checkpoint, which resume handles gracefully (truncate to
+            # the restored cursor's marker, re-run one loop). The reverse
+            # order could leave a checkpoint ahead of the stream — a
+            # state the sink can only treat as unresumable, abandoning
+            # the whole stream (obs/sinks.py _scan).
+            self.recorder.commit_loop(nloop)
             if cfg.save_model:
                 self.save(step=self._completed_nloops)
         if cfg.save_model:
             self.save(step=cfg.nloop)
+        # end-of-run communication summary: partial-parameter exchange vs
+        # the hypothetical full-model exchange vs the ship-the-data floor
+        self.recorder.log("comm_summary", self._comm.summary())
         return self.recorder
 
     # ----------------------------------------------------------- checkpoint

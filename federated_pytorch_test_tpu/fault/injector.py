@@ -40,6 +40,16 @@ class FaultInjector:
         self._plan_tag = hashlib.md5(plan.to_json().encode()).hexdigest()[:8]
         self._fired: Set[str] = set()
 
+    @property
+    def plan_tag(self) -> str:
+        """8-hex digest identifying THIS plan — the same scope the crash
+        sentinels use. The JSONL metric stream stamps it into its header
+        (obs/sinks.py): a resumed run may only splice onto a stream whose
+        faults were drawn from the identical plan, or the replayed and
+        re-run halves of the series would disagree about who dropped when.
+        """
+        return self._plan_tag
+
     def mask(self, nloop: int, gid: int, nadmm: int) -> np.ndarray:
         """`[K]` float32 participation mask for one consensus round."""
         return self.plan.participation(self.n_clients, nloop, gid, nadmm)

@@ -1,0 +1,27 @@
+"""Observability: streaming sinks, the communication ledger, trace export.
+
+Three pillars over the structured metric store (`utils/metrics.py`):
+
+* `JsonlSink` — a crash-safe append-only JSONL metric stream with
+  per-outer-loop commit markers; `resume='auto'` replays it and truncates
+  to the restore point, so a chaos run's metric series is continuous
+  across crashes (sinks.py);
+* `CommLedger` — exact per-round communicated bytes from the static
+  `Partition` spec, dtype, and participation masks: the quantity the
+  paper's bandwidth claim is about, finally measured (ledger.py);
+* `TraceRecorder` / `DispatchCounter` — host-side span recording exported
+  as Chrome trace-event JSON (loadable in Perfetto) plus dispatch- and
+  recompile-count series, so fusion regressions show up as metrics
+  (trace.py).
+"""
+
+from federated_pytorch_test_tpu.obs.ledger import CommLedger
+from federated_pytorch_test_tpu.obs.sinks import JsonlSink
+from federated_pytorch_test_tpu.obs.trace import DispatchCounter, TraceRecorder
+
+__all__ = [
+    "CommLedger",
+    "DispatchCounter",
+    "JsonlSink",
+    "TraceRecorder",
+]

@@ -1,0 +1,141 @@
+"""Communication-volume ledger: the paper's headline quantity, measured.
+
+The reference's one-sentence thesis is that communicating ONE parameter
+group per round slashes bandwidth (reference README.md:2), and related
+work reports exactly this figure — L-FGADMM (arXiv:1911.03654) plots
+layer-wise communication cost, TAMUNA (arXiv:2302.09832) its sparsified
+exchange volume under partial participation. Until this module nothing in
+the repo computed communicated bytes at all.
+
+The volume is *exact and static*, not sampled: every consensus exchange
+moves the active group's coordinates — `Partition.group_size(gid)` values
+of the parameter dtype — for each PARTICIPATING client (consensus/
+fedavg.py, consensus/admm.py: a dropped client's contribution is excluded
+from the masked aggregation and it does not receive the broadcast, so it
+contributes zero bytes in both directions). The recorded `comm_bytes`
+series is the UPLINK volume of one exchange,
+
+    comm_bytes = group_size(gid) * dtype_bytes * survivors,
+
+the hand-computable contract of tests/test_obs.py; the symmetric
+consensus broadcast doubles it, which the summary reports separately.
+
+Two baselines put the number in context:
+
+* **full-parameter exchange** — the same schedule shipping the WHOLE
+  flat vector every round (what naive FedAvg/ADMM without the partition
+  would send): `total * dtype_bytes * survivors` per round. The
+  `savings_vs_full` ratio is the paper's claim as a number.
+* **data-transfer floor** — shipping the raw training shards to one host
+  once and training centrally (the non-federated alternative federated
+  learning exists to avoid); a run whose cumulative model traffic
+  exceeds it has spent more wire than centralization would have.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class CommLedger:
+    """Accumulates per-round communicated bytes for one experiment."""
+
+    def __init__(
+        self,
+        partition,
+        n_clients: int,
+        dtype_bytes: int = 4,
+        data_floor_bytes: Optional[int] = None,
+    ):
+        self.partition = partition
+        self.n_clients = int(n_clients)
+        self.dtype_bytes = int(dtype_bytes)
+        self.data_floor_bytes = (
+            int(data_floor_bytes) if data_floor_bytes is not None else None
+        )
+        self._uplink = 0
+        self._full = 0
+        self._rounds = 0
+
+    # --------------------------------------------------------- pure queries
+
+    def round_bytes(self, gid: int, survivors: int) -> int:
+        """Uplink bytes of ONE consensus exchange of group `gid`."""
+        return self.partition.group_size(gid) * self.dtype_bytes * int(survivors)
+
+    def full_round_bytes(self, survivors: int) -> int:
+        """The same exchange if the WHOLE parameter vector were sent."""
+        return self.partition.total * self.dtype_bytes * int(survivors)
+
+    def savings_vs_full(self, group_order: Sequence[int]) -> float:
+        """Partial-vs-full ratio for one pass over `group_order`.
+
+        Pure partition arithmetic (participation cancels): how many times
+        MORE a whole-model exchange would move than the per-group one,
+        over one outer loop's visit order.
+        """
+        part = sum(self.partition.group_size(g) for g in group_order)
+        return self.partition.total * len(group_order) / part
+
+    # ---------------------------------------------------------- accumulation
+
+    def account(self, gid: int, survivors: int) -> int:
+        """Accumulate one consensus exchange into the totals (no record).
+
+        Used directly by the resume path to reconstruct rounds that will
+        NOT re-run and left no stream to absorb: every fault mask is a
+        pure function of (plan seed, round cursor), so the pre-restore
+        traffic is recomputable exactly (engine/trainer.py).
+        """
+        b = self.round_bytes(gid, survivors)
+        self._uplink += b
+        self._full += self.full_round_bytes(survivors)
+        self._rounds += 1
+        return b
+
+    def record(self, recorder, gid: int, survivors: int, *, nloop, nadmm) -> None:
+        """Account one consensus exchange and log its `comm_bytes` record."""
+        b = self.account(gid, survivors)
+        recorder.log(
+            "comm_bytes",
+            int(b),
+            nloop=nloop,
+            group=gid,
+            nadmm=nadmm,
+            survivors=int(survivors),
+        )
+
+    def absorb(self, records: Sequence[dict]) -> None:
+        """Seed the totals from replayed `comm_bytes` records.
+
+        A resumed run replays the pre-crash rounds from the JSONL stream
+        instead of re-running them; absorbing their records keeps the
+        end-of-run summary identical to an uninterrupted run's.
+        """
+        for rec in records:
+            s = int(rec.get("survivors", self.n_clients))
+            self._uplink += int(rec["value"])
+            self._full += self.full_round_bytes(s)
+            self._rounds += 1
+
+    def summary(self) -> dict:
+        """End-of-run totals vs the two baselines (module docstring)."""
+        up, full = self._uplink, self._full
+        return {
+            "rounds": self._rounds,
+            "n_clients": self.n_clients,
+            "dtype_bytes": self.dtype_bytes,
+            "bytes_total": int(up),
+            "bytes_total_bidirectional": int(2 * up),
+            "bytes_per_round_mean": (
+                round(up / self._rounds, 1) if self._rounds else None
+            ),
+            "bytes_full_exchange": int(full),
+            "savings_vs_full": round(full / up, 4) if up else None,
+            "data_floor_bytes": self.data_floor_bytes,
+            "vs_data_floor": (
+                round(up / self.data_floor_bytes, 6)
+                if self.data_floor_bytes
+                else None
+            ),
+        }

@@ -1,0 +1,192 @@
+"""Streaming metric sinks: the crash-durable half of the metric store.
+
+`MetricsRecorder` is in-memory; before this module a crash+resume lost
+every recorded series even though the *parameters* recovered (the PR-1
+checkpoint layer). A sink receives every record as it is logged and makes
+it durable incrementally.
+
+The JSONL line protocol (one JSON object per line):
+
+    {"event": "stream_header", "version": 1, "tag": "<experiment tag>"}
+    {"series": "<name>", "t": ..., "value": ..., <context keys>}
+    {"event": "nloop_complete", "nloop": N}
+
+* Every record is ONE line-buffered `write()` of a newline-terminated
+  line, so a crash can tear at most the final line — never interleave or
+  split earlier ones.
+* `flush()` (called by the trainer once per partition round) pushes the
+  buffer to the OS; `commit(nloop)` (called at each outer-loop checkpoint
+  boundary) writes the marker line and fsyncs: everything before a marker
+  is durable and complete.
+* On `resume='auto'` the trainer reopens the stream with
+  `open(resume_nloops=C)`: the file is truncated to the byte just past
+  the `nloop_complete` marker of loop `C-1` (the restore point — the
+  rounds after it will be re-run and re-recorded), the surviving records
+  are returned for replay into the in-memory store, and writing resumes
+  in append mode. A torn final line or any garbage past the last parsable
+  line is discarded. The resumed stream is therefore identical to an
+  uninterrupted run's (modulo wall-clock `t` fields) — the continuity
+  contract tested in tests/test_obs.py.
+* A header-tag mismatch (different preset/seed/fault plan writing to the
+  same path) or a missing restore-point marker abandons the old stream
+  with a warning and starts fresh: splicing two different experiments'
+  series would be worse than losing one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+STREAM_VERSION = 1
+
+
+def jsonable(o: Any):
+    """`json.dumps` default hook for the numpy scalars/arrays metric
+    values occasionally carry (recorder APIs convert, raw `log()` calls
+    may not)."""
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.bool_):
+        return bool(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+class JsonlSink:
+    """Crash-safe append-only JSONL metric stream (see module docstring).
+
+    Lifecycle: construct, `open(...)` (returns records to replay), then
+    `record`/`flush`/`commit` from the recorder, `close()` at run end.
+    All writers are no-ops after `close()` — a test poking a finished
+    trainer must not crash on a closed file.
+    """
+
+    MARKER = "nloop_complete"
+
+    def __init__(self, path: str, tag: str = ""):
+        self.path = os.path.abspath(path)
+        self.tag = tag
+        self._f = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def open(
+        self, resume_nloops: Optional[int] = None
+    ) -> List[Tuple[str, dict]]:
+        """Open the stream; returns `[(series, record), ...]` to replay.
+
+        `resume_nloops=None` starts a fresh stream (truncating any prior
+        file); an integer `C` resumes: truncate to the commit marker of
+        loop `C-1` (just the header for `C == 0`) and replay what's kept.
+        """
+        if resume_nloops is None or not os.path.exists(self.path):
+            self._start_fresh()
+            return []
+        records, cut = self._scan(int(resume_nloops))
+        if cut is None:
+            self._start_fresh()
+            return []
+        os.truncate(self.path, cut)
+        self._f = open(self.path, "a", buffering=1)
+        return records
+
+    def _start_fresh(self) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "w", buffering=1)
+        self._write(
+            {"event": "stream_header", "version": STREAM_VERSION, "tag": self.tag}
+        )
+
+    def _scan(self, resume_nloops: int):
+        """Find the truncation offset for a resume at `resume_nloops`.
+
+        Returns `(records_to_replay, byte_offset)`; offset None means the
+        stream cannot be resumed (tag mismatch, no header, or the restore
+        point's marker is missing) and a fresh stream must be started.
+        """
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos = 0
+        cut = None
+        upto: List[Tuple[str, dict]] = []
+        records: List[Tuple[str, dict]] = []
+        header_seen = False
+        for raw in data.splitlines(keepends=True):
+            end = pos + len(raw)
+            if not raw.endswith(b"\n"):
+                break  # torn tail from a crash mid-write
+            try:
+                d = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                break  # corrupt line: nothing past it is trustworthy
+            if not header_seen:
+                header_seen = True
+                if (
+                    d.get("event") != "stream_header"
+                    or d.get("tag") != self.tag
+                ):
+                    warnings.warn(
+                        f"metric stream {self.path} was written by a "
+                        f"different experiment (tag {d.get('tag')!r} != "
+                        f"{self.tag!r}); starting a fresh stream"
+                    )
+                    return [], None
+                if resume_nloops == 0:
+                    cut = end  # keep just the header; re-run records all
+                pos = end
+                continue
+            if d.get("event") == self.MARKER:
+                if int(d.get("nloop", -1)) == resume_nloops - 1:
+                    # the restore point: records before it are final
+                    cut = end
+                    records = list(upto)
+            elif "series" in d:
+                name = d.pop("series")
+                upto.append((name, d))
+            pos = end
+        if cut is None and header_seen:
+            warnings.warn(
+                f"metric stream {self.path} has no commit marker for "
+                f"outer loop {resume_nloops - 1} (checkpoints and stream "
+                "are out of step); starting a fresh stream"
+            )
+            return [], None
+        return records, cut
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+    # -------------------------------------------------------------- writers
+
+    def _write(self, d: dict) -> None:
+        # one write per line; line buffering makes the newline the flush
+        # boundary, so a crash tears at most this line
+        self._f.write(json.dumps(d, default=jsonable) + "\n")
+
+    def record(self, name: str, rec: dict) -> None:
+        if self._f is not None:
+            self._write({"series": name, **rec})
+
+    def commit(self, nloop: int) -> None:
+        """Durability barrier: marker + fsync at a checkpoint boundary."""
+        if self._f is not None:
+            self._write({"event": self.MARKER, "nloop": int(nloop)})
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()

@@ -8,13 +8,28 @@ and dual residuals, mean rho, and per-client test accuracy. Here every
 observation lands in a structured in-memory store (JSON-serializable) AND
 is printed in a format close to the reference's, so the same shell recipes
 still work.
+
+The store is extended by the `obs/` layer (docs/OBSERVABILITY.md):
+
+* **sinks** — every `log()` record is forwarded to pluggable sinks
+  (`obs/sinks.py JsonlSink` is the crash-safe streaming one); `flush()` /
+  `commit_loop()` are the trainer's per-round and per-checkpoint
+  durability barriers, and `add_sink(..., replay=...)` seeds the
+  in-memory series from a resumed stream so a crash+resume run's series
+  is continuous;
+* **tracer** — `phase()` is the ONE enter/exit context manager shared by
+  the wall-clock `step_time` records and the Chrome-trace span recorder
+  (`obs/trace.py`), so the timing series and the exported trace can never
+  disagree about what was measured.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -34,11 +49,69 @@ class MetricsRecorder:
     # the run is healthy (see _flag_nonfinite). Frozen once set: the first
     # poisoned round is the diagnostic one, everything after is fallout.
     first_nonfinite: Optional[dict] = None
+    # streaming sinks (obs/sinks.py protocol: record/flush/commit/close)
+    # and the optional trace-span recorder (obs/trace.py TraceRecorder)
+    sinks: List[Any] = dataclasses.field(default_factory=list)
+    tracer: Optional[Any] = None
     _t0: float = dataclasses.field(default_factory=time.perf_counter)
 
-    def log(self, name: str, value: Any, **context) -> None:
+    def log(self, name: str, value: Any, *, stream: bool = True, **context) -> None:
+        """Append one record; `stream=False` keeps it OUT of the sinks —
+        for series that are facts about THIS PROCESS rather than the run's
+        trajectory (`recompile_count`: a resumed process recompiles
+        programs the crashed one had warm, so streaming it would break the
+        crash/resume stream-continuity contract)."""
         rec = {"t": time.perf_counter() - self._t0, "value": value, **context}
         self.series.setdefault(name, []).append(rec)
+        if stream:
+            for s in self.sinks:
+                s.record(name, rec)
+
+    # ------------------------------------------------------ sinks & tracing
+
+    def add_sink(self, sink, replay=()) -> None:
+        """Attach a sink, optionally seeding the store from its replayed
+        records (a resumed JSONL stream): replayed records enter `series`
+        directly — NOT re-forwarded to the sink, which already holds them
+        — and a replayed `nonfinite_flag` restores the poisoned cursor."""
+        for name, rec in replay:
+            self.series.setdefault(name, []).append(rec)
+            if name == "nonfinite_flag" and self.first_nonfinite is None:
+                self.first_nonfinite = dict(rec["value"])
+        self.sinks.append(sink)
+
+    def flush(self) -> None:
+        """Per-round durability: push buffered sink writes to the OS."""
+        for s in self.sinks:
+            s.flush()
+
+    def commit_loop(self, nloop: int) -> None:
+        """Checkpoint-boundary durability: marker + fsync in every sink.
+        The JSONL resume path truncates to these markers (obs/sinks.py)."""
+        for s in self.sinks:
+            s.commit(nloop)
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+    @contextlib.contextmanager
+    def phase(self, phase: str, *, record: bool = True, **context):
+        """Time one phase: a tracer span plus (optionally) a `step_time`
+        record — the shared enter/exit point of the timing series and the
+        Chrome trace (obs/trace.py). `record=False` emits the span only,
+        keeping the `step_time` series exactly its pre-obs phase set
+        (epoch / consensus / fused_round / straggler_wait)."""
+        t0 = time.perf_counter()
+        cm = (
+            self.tracer.span(phase, **context)
+            if self.tracer is not None
+            else contextlib.nullcontext()
+        )
+        with cm:
+            yield
+        if record:
+            self.step_time(phase, time.perf_counter() - t0, **context)
 
     def _flag_nonfinite(self, name: str, values, context: dict) -> None:
         """Flag the FIRST NaN/Inf observation with its loop cursor.
@@ -160,16 +233,50 @@ class MetricsRecorder:
         """
         ids = [int(c) for c in clients]
         self.log("fault", {"kind": kind, "clients": ids}, **context)
+        if self.tracer is not None:
+            self.tracer.instant(f"fault:{kind}", clients=ids, **context)
         if self.verbose:
             ctx = " ".join(f"{k}={v}" for k, v in context.items())
             print(f"FAULT kind={kind} clients={ids} {ctx}")
+
+    def group_distance(self, dists, *, nloop, group) -> None:
+        """Per-group distance-from-mean diagnostic (`[num_groups]`).
+
+        The series `parallel/diagnostics.py group_distances` feeds when
+        the trainer's `--diagnostics-every N` cadence is on — the
+        reference defines the equivalent `distance_of_layers` but never
+        calls it (reference src/federated_trio.py:170-186).
+        """
+        vals = [float(v) for v in dists]
+        self.log("group_distance", vals, nloop=nloop, group=group)
+        if self.verbose:
+            print(
+                f"group_distance nloop={nloop} group={group} "
+                + ",".join(f"{v:e}" for v in vals)
+            )
 
     def latest(self, name: str):
         return self.series[name][-1]["value"] if self.series.get(name) else None
 
     def to_json(self) -> str:
-        return json.dumps(self.series)
+        """The full store as JSON: `{"series": ..., "first_nonfinite": ...}`.
+
+        The envelope carries the poisoned-round cursor alongside the
+        series — a bare-series dump would lose exactly the record a
+        post-mortem of a `--metrics-out` file needs.
+        """
+        return json.dumps(
+            {"series": self.series, "first_nonfinite": self.first_nonfinite}
+        )
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
+        """Atomic write (tmp + rename, the `utils/checkpoint.py` pattern):
+        a crash mid-write replaces the file completely or not at all,
+        never with torn JSON."""
+        path = os.path.abspath(path)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             f.write(self.to_json())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

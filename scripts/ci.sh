@@ -3,12 +3,17 @@
 #
 #   tier 0  pytest -m smoke        — <2 min on the virtual 8-device CPU
 #                                    mesh: kernels, consensus math,
-#                                    collectives, fault-plan purity
+#                                    collectives, fault-plan purity,
+#                                    obs units (JSONL sink truncation,
+#                                    comm-ledger arithmetic, trace JSON)
 #   tier 1  pytest -m 'not slow'   — the DEFAULT budgeted gate (the
 #                                    driver's verify command): smoke plus
 #                                    the middle tier (partition, models,
 #                                    trainer-level chaos, fused-round
-#                                    bit-identity), ~5 min
+#                                    bit-identity, crash/resume metric-
+#                                    stream continuity, dispatch/trace
+#                                    integration — tests/test_obs.py),
+#                                    ~5 min
 #   tier 2  pytest -m slow         — full integration (~20+ min): engine
 #                                    sweeps, resnet-engine runs,
 #                                    streaming-equivalence, Pallas

@@ -70,6 +70,10 @@ def test_tiny_training_run_with_metrics_out(tmp_path):
         "--metrics-out", str(out),
     )
     assert r.returncode == 0, r.stderr[-2000:]
-    series = json.loads(out.read_text())
+    doc = json.loads(out.read_text())  # envelope: series + nonfinite cursor
+    series = doc["series"]
+    assert doc["first_nonfinite"] is None  # healthy run
     assert "train_loss" in series and "dual_residual" in series
     assert len(series["train_loss"][-1]["value"]) == 4  # per-client losses
+    # the observability summary lines made it to stdout
+    assert "# series:" in r.stdout and "# comm:" in r.stdout

@@ -114,7 +114,7 @@ def test_pod_scale64_example_smoke(tmp_path):
     assert "scale64 run complete" in proc.stdout
     import json
 
-    rec = json.loads(out.read_text())  # MetricsRecorder.to_json: the series
+    rec = json.loads(out.read_text())["series"]  # MetricsRecorder.to_json
     # the scale64 presets run with check_results=False (throughput mode),
     # so the recorded series are losses/residuals, not accuracies
     assert rec["train_loss"], "no loss series recorded"

@@ -70,7 +70,7 @@ def test_chaos_kill_and_resume_via_cli(tmp_path):
 
     second = _run(*args)  # the IDENTICAL command: operator just reruns it
     assert second.returncode == 0, second.stderr[-2000:]
-    series = json.loads(out.read_text())
+    series = json.loads(out.read_text())["series"]
     assert "train_loss" in series and "dual_residual" in series
     # chaos telemetry made it through the full pipeline
     assert "participation" in series
