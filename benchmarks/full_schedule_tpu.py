@@ -55,6 +55,14 @@ def main() -> None:
     # dispatch round (engine/steps.py build_round_fn) — for measuring
     # the dispatch tail the fusion harvests
     ap.add_argument("--no-fuse-rounds", action="store_true")
+    # escape hatch: per-consensus-round evals as standalone dispatches on
+    # the round's state snapshots instead of folded inside the fused
+    # program — for measuring the eval tail the fold harvests (the full
+    # fedavg/admm schedules issue 180/300 standalone eval launches)
+    ap.add_argument("--no-fold-eval", action="store_true")
+    # JAX persistent compilation cache: warm reruns of the same schedule
+    # skip XLA backend compilation (config.compile_cache)
+    ap.add_argument("--compile-cache", metavar="DIR", default=None)
     # load a REAL-FORMAT on-disk archive (scripts/make_cifar_archive.py
     # writes a checksum-verified one in the published binary layout) via
     # the real loader path — native bin decoding, no synthetic fallback
@@ -71,6 +79,10 @@ def main() -> None:
     over = {"nloop": args.nloop} if args.nloop is not None else {}
     if args.no_fuse_rounds:
         over["fuse_rounds"] = False
+    if args.no_fold_eval:
+        over["fold_eval"] = False
+    if args.compile_cache:
+        over["compile_cache"] = args.compile_cache
     if args.stream:
         over.update(hbm_data_budget_mb=0, stream_chunk_steps=8)
     if args.real_archive:
@@ -149,6 +161,26 @@ def main() -> None:
         "fused_round_time_median_s": (
             round(float(np.median(round_times)), 3) if round_times else None
         ),
+        # eval placement (the eval-tail PR): 'folded' = evals inside the
+        # fused round program (default — zero standalone eval dispatches),
+        # 'async' = standalone eval dispatches with deferred host
+        # harvest (--no-fold-eval, or wherever fusion falls back),
+        # 'sync' would require --no-async-eval too
+        "eval_mode": (
+            "folded" if tr._fold_eval_enabled()
+            else "async" if cfg.async_eval and cfg.check_results
+            else "sync" if cfg.check_results
+            else None
+        ),
+        "round_dispatches_total": sum(
+            r["value"].get("total", 0)
+            for r in rec.series.get("dispatch_count", [])
+        ),
+        "eval_dispatches_total": sum(
+            r["value"].get("eval", 0)
+            for r in rec.series.get("dispatch_count", [])
+        ),
+        "compile_cache": args.compile_cache,
         # the communication ledger (obs/ledger.py): exact per-exchange
         # uplink bytes and the end-of-run summary comparing the partial-
         # parameter schedule against the hypothetical full-model exchange
@@ -173,6 +205,11 @@ def main() -> None:
         out["final_mean_rho"] = float(rec.latest("mean_rho"))
 
     suffix = "_realformat" if args.real_archive else ""
+    # the escape-hatch comparison pairs must not overwrite their baselines
+    if args.no_fuse_rounds:
+        suffix += "_nofused"
+    if args.no_fold_eval:
+        suffix += "_nofoldeval"
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"full_{args.preset}{suffix}_tpu.json",

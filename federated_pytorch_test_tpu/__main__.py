@@ -10,10 +10,15 @@ scripts are presets and every constant is a flag:
     python -m federated_pytorch_test_tpu --list-presets
 
 Rounds run FUSED by default — each partition group's full averaging
-round (every epoch + consensus exchange) is one jitted dispatch
-(engine/steps.py build_round_fn); `--no-fuse-rounds` restores the
-per-epoch dispatch path (bit-identical trajectory, more dispatch
-latency).
+round (every epoch + consensus exchange + the `check_results` eval
+sweeps) is one jitted dispatch (engine/steps.py build_round_fn);
+`--no-fuse-rounds` restores the per-epoch dispatch path and
+`--no-fold-eval` moves the evals back outside the round program (both
+bit-identical trajectories, more dispatch latency). Evals outside a
+fused program are enqueued asynchronously and harvested at round
+boundaries (`--no-async-eval` restores the blocking per-eval fetch).
+`--compile-cache DIR` persists XLA executables so warm reruns skip
+backend compilation.
 
 Chaos runs (fault/, docs/FAULT.md) ride the same config surface:
 `--fault-plan "seed=1,dropout=0.3,crash=0:1:2"` (or a FaultPlan JSON

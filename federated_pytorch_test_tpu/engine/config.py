@@ -158,6 +158,37 @@ class ExperimentConfig:
     # dispatch program would be exactly the long-scan shape that cap
     # exists to avoid).
     fuse_rounds: bool = True
+    # fold the `check_results` eval cadence INTO the fused round program:
+    # each consensus iteration's full-test-set sweep runs inside the same
+    # jitted dispatch, against the same post-consensus state the outside
+    # path would snapshot — a fused+folded round is exactly ONE program
+    # launch with ZERO standalone eval dispatches and no blocking host
+    # sync before the next round enqueues (the eval tail PR 2 left
+    # behind: the full fedavg/admm schedules issued 180/300 standalone
+    # eval launches against 60 round launches, each ending in a host
+    # sync). Correct counts are bit-identical to the standalone eval
+    # program's (the per-client body is shared — engine/steps.py
+    # _client_eval_fn; tested in tests/test_fold_eval.py).
+    # `--no-fold-eval` is the escape hatch; folding stands down wherever
+    # round fusion itself does (`Trainer._fused_enabled`), falling back
+    # to the async outside-the-program eval path below.
+    fold_eval: bool = True
+    # defer the device->host harvest of evals that run OUTSIDE the fused
+    # program (the unfused/fallback paths and `--no-fold-eval`): the
+    # jitted eval sweep is ENQUEUED at its cadence point (dispatch is
+    # asynchronous) but the blocking fetch moves to the round boundary,
+    # where all of a round's deferred records are harvested in batch —
+    # always before the metric stream's `nloop_complete` marker and the
+    # checkpoint are written, so crash-safety and the resumed-stream
+    # identity contract are unchanged (utils/metrics.py Deferred,
+    # obs/sinks.py). False makes every eval's fetch BLOCK at its call
+    # site (the pre-async stall pattern, for timing comparisons); the
+    # record itself still rides the round-boundary harvest — stream
+    # content and order are identical either way, and verbose accuracy
+    # prints appear at the harvest in both modes (that shared path is
+    # what lets rollback discard a poisoned round's evals in every
+    # eval mode).
+    async_eval: bool = True
     # cap on lockstep minibatches per RESIDENT jitted epoch call: epochs
     # longer than this run as ceil(S/cap) sequential calls over index
     # slices (bit-identical trajectory — the scan is sequential either
@@ -171,6 +202,16 @@ class ExperimentConfig:
 
     # write a jax.profiler trace of each epoch here (TPU/host timelines)
     profile_dir: str | None = None
+
+    # JAX persistent compilation cache directory (`--compile-cache DIR`):
+    # XLA executables are cached on disk, so a warm rerun of the same
+    # config pays tracing but not backend compilation — minutes off the
+    # full reference schedules' first round. None leaves whatever cache
+    # the process already configured (the test conftest sets one
+    # globally; utils/hostcpu.py compile_cache_dir is the repo-level
+    # location). The cache is keyed by program + compile options, so
+    # sharing one directory across configs is safe.
+    compile_cache: str | None = None
 
     # --- observability (obs/, docs/OBSERVABILITY.md) ---
     # crash-safe append-only JSONL metric stream: every record is written

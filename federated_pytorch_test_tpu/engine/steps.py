@@ -27,6 +27,11 @@ One dispatch per round instead of `nadmm*(nepoch+1)` harvests the flat
 (benchmarks/epoch_attribution.json); the per-dispatch builders remain the
 `--no-fuse-rounds` escape hatch and serve the cases fusion cannot
 (streaming, per-batch eval, per-epoch eval cadence, over-cap scans).
+With `fold_eval=True` (the default when `check_results` is on) the
+per-consensus-round eval sweep rides INSIDE the same program — one
+dispatch carries the round's training, consensus, and evals, and the
+standalone eval program never launches (`--no-fold-eval` restores the
+snapshot + outside-eval path).
 
 BatchNorm models thread a `batch_stats` collection through the scan.
 Deliberate deviation (SURVEY.md §7 hard part 5): the reference mutates
@@ -537,6 +542,41 @@ def build_consensus_fn(ctx: GroupContext, mesh, counter=None):
     return _counted(jax.jit(sharded), counter, "consensus")
 
 
+def _client_eval_fn(model, unravel, has_stats: bool):
+    """One client's full-test-sweep correct-count body.
+
+    Shared by the standalone eval program (`build_eval_fn`) and the
+    folded per-consensus-round eval inside the fused round
+    (`build_round_fn(fold_eval=True)`): the SAME ops in the same order,
+    so a folded round's correct counts equal the standalone program's.
+    `(flat [N], stats, test_imgs [T,B,...], test_labels [T,B],
+    test_mask [T,B], mean, std) -> correct (i32 scalar)`.
+    """
+
+    def client_eval(flat, stats, test_imgs, test_labels, test_mask, mean, std):
+        params = unravel(flat)
+        variables = {"params": params}
+        if has_stats:
+            variables["batch_stats"] = stats
+
+        def body(correct, batch):
+            img, lab, msk = batch
+            logits = model.apply(variables, normalize(img, mean, std), train=False)
+            pred = jnp.argmax(logits, axis=-1)
+            return correct + jnp.sum((pred == lab) & msk), None
+
+        # seed the scan carry with the client axis's varying type —
+        # required by vma checking, numerically an exact zero
+        correct, _ = lax.scan(
+            body,
+            jnp.int32(0) + vma_zero(mean).astype(jnp.int32),
+            (test_imgs, test_labels, test_mask),
+        )
+        return correct
+
+    return client_eval
+
+
 def build_round_fn(
     ctx: GroupContext,
     mesh,
@@ -544,6 +584,7 @@ def build_round_fn(
     nadmm: int,
     nepoch: int,
     snapshot: bool = False,
+    fold_eval: bool = False,
     counter=None,
 ):
     """One partition group's FULL averaging round as ONE jitted program.
@@ -565,12 +606,14 @@ def build_round_fn(
       (flat [K,N], lstate, stats, shard_imgs [K,n,H,W,C] u8,
        shard_labels [K,n], idx [nadmm, nepoch, S, K, B],
        mean [K], std [K], y [K,G], z [G], rho [K,1], extra,
-       masks [nadmm, K])
+       masks [nadmm, K]
+       [, test_imgs [T,B,...], test_labels [T,B], test_mask [T,B]
+          — static `fold_eval=True` only])
       -> (flat, lstate, stats, y, z, rho, extra,
           losses [nadmm, nepoch, S, K],
           met (dual, primal, mean_rho, survivors) each [nadmm],
           param_ok [nadmm, K] bool,
-          snaps)
+          snaps, correct)
 
     * `idx` is the whole round's shuffle schedule, precomputed host-side
       (the trainer stacks its deterministic per-(nadmm, epoch)
@@ -587,17 +630,39 @@ def build_round_fn(
     * `snaps` (static `snapshot=True` only, else `()`): the
       `(flat, stats)` state after EVERY consensus exchange,
       `[nadmm, K, ...]` — what `check_results`' per-round eval cadence
-      reads, since mid-round state is otherwise fused away. Eval itself
-      stays OUTSIDE the fused program.
+      reads when eval runs OUTSIDE the program (`--no-fold-eval`).
+    * `correct` (static `fold_eval=True` only, else `()`): the
+      `check_results` eval cadence FOLDED INTO the round — after every
+      consensus exchange the scan body runs the full padded test sweep
+      (`_client_eval_fn`, the exact body `build_eval_fn` dispatches
+      standalone) against the post-consensus `(flat, stats)` and emits
+      the `[nadmm, K]` i32 correct counts. One dispatch then carries the
+      round's training, consensus, AND evals — no standalone eval
+      launches, no mid-round `[nadmm, K, N]` state snapshots
+      materialized. `snapshot` and `fold_eval` are mutually exclusive
+      (folding replaces the snapshot consumer).
 
     `nadmm`/`nepoch` are static (they shape the scan); donation matches
-    `build_epoch_fn` (flat/lstate/stats update in place).
+    `build_epoch_fn` (flat/lstate/stats update in place; the test sweep
+    is NOT donated — it is staged once and reused every round).
     """
+    if snapshot and fold_eval:
+        raise ValueError(
+            "snapshot and fold_eval are mutually exclusive: folding runs "
+            "the eval inside the program, so the snapshots it would feed "
+            "are never materialized"
+        )
     client_step = _client_train_step(ctx)
     consensus_local = _consensus_local(ctx)
+    client_eval = (
+        _client_eval_fn(ctx.model, ctx.unravel, ctx.has_stats)
+        if fold_eval
+        else None
+    )
 
     def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
-              y, z, rho, extra, masks):
+              y, z, rho, extra, masks,
+              test_imgs=None, test_labels=None, test_mask=None):
 
         def round_body(carry, xs):
             flat, lstate, stats, y, z, rho, extra = carry
@@ -644,6 +709,14 @@ def build_round_fn(
             ys = (losses, met, param_ok)
             if snapshot:
                 ys = ys + ((flat, stats),)
+            if fold_eval:
+                # the folded check_results cadence: the full test sweep at
+                # the post-consensus state, inside the same dispatch — the
+                # per-client body is build_eval_fn's, bit for bit
+                correct = jax.vmap(
+                    client_eval, in_axes=(0, 0, None, None, None, 0, 0)
+                )(flat, stats, test_imgs, test_labels, test_mask, mean, std)
+                ys = ys + (correct,)
             return (flat, lstate, stats, y, z, rho, extra), ys
 
         carry = (flat, lstate, stats, y, z, rho, extra)
@@ -652,8 +725,9 @@ def build_round_fn(
         flat, lstate, stats, y, z, rho, extra = carry
         losses, met, param_ok = ys[:3]
         snaps = ys[3] if snapshot else ()
+        correct = ys[-1] if fold_eval else ()
         return (flat, lstate, stats, y, z, rho, extra,
-                losses, met, param_ok, snaps)
+                losses, met, param_ok, snaps, correct)
 
     c = P(CLIENT_AXIS)
     r = P()
@@ -664,12 +738,15 @@ def build_round_fn(
         c, c, c, r, c, (c, c),
         sc1,  # masks [nadmm, K]
     )
+    if fold_eval:
+        in_specs = in_specs + (r, r, r)  # replicated [T,B,...] test sweep
     out_specs = (
         c, c, c, c, r, c, (c, c),
         P(None, None, None, CLIENT_AXIS),  # losses [nadmm, nepoch, S, K]
         (r, r, r, r),  # per-nadmm metric series
         sc1,  # param_ok [nadmm, K]
         (sc1, sc1) if snapshot else (),  # post-consensus state snapshots
+        sc1 if fold_eval else (),  # folded-eval correct counts [nadmm, K]
     )
     sharded = shard_map(
         local,
@@ -694,29 +771,11 @@ def build_eval_fn(model, unravel, has_stats: bool, mesh, counter=None):
     The reference's `verification_error_check` iterates each client's
     testloader in Python (reference src/federated_trio.py:199-223); here
     one call scans the whole padded `[T,B,...]` test set on device for all
-    clients and returns `[K]` correct counts (top-1).
+    clients and returns `[K]` correct counts (top-1). The per-client body
+    is `_client_eval_fn` — shared with the fused round's folded eval, so
+    the standalone and folded cadences compute identical counts.
     """
-
-    def client_eval(flat, stats, test_imgs, test_labels, test_mask, mean, std):
-        params = unravel(flat)
-        variables = {"params": params}
-        if has_stats:
-            variables["batch_stats"] = stats
-
-        def body(correct, batch):
-            img, lab, msk = batch
-            logits = model.apply(variables, normalize(img, mean, std), train=False)
-            pred = jnp.argmax(logits, axis=-1)
-            return correct + jnp.sum((pred == lab) & msk), None
-
-        # seed the scan carry with the client axis's varying type —
-        # required by vma checking, numerically an exact zero
-        correct, _ = lax.scan(
-            body,
-            jnp.int32(0) + vma_zero(mean).astype(jnp.int32),
-            (test_imgs, test_labels, test_mask),
-        )
-        return correct
+    client_eval = _client_eval_fn(model, unravel, has_stats)
 
     def local(flat, stats, test_imgs, test_labels, test_mask, mean, std):
         # the client-sharded out-spec assembles local [K_loc] blocks into

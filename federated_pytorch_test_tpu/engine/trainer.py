@@ -5,12 +5,17 @@ they are near-clones differing only in model, loop sizes, and which
 coordination algorithm is inlined). The loop nest is the reference's
 `Nloop { groups { Nadmm { epochs { batches } } } }`
 (reference src/federated_trio.py:11-14,256-285). By default the whole
-`Nadmm { epochs { batches } + consensus }` body of one partition round is
-ONE jitted dispatch (`_run_round_fused`, engine/steps.py build_round_fn);
+`Nadmm { epochs { batches } + consensus + eval }` body of one partition
+round — the `check_results` eval sweeps included (`fold_eval`) — is ONE
+jitted dispatch (`_run_round_fused`, engine/steps.py build_round_fn);
 with `--no-fuse-rounds` (or where fusion cannot preserve semantics —
 `_fused_enabled`) each `{batches}` body is one jitted sharded epoch call
 and each consensus exchange one jitted collective, the same trajectory
-bit for bit.
+bit for bit. Evals that run outside a fused program are ASYNC: the
+sweep is enqueued at its cadence point and the blocking host fetch is
+deferred to the round boundary (`evaluate_deferred`,
+utils/metrics.py Deferred), so no eval stalls the device queue between
+rounds.
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -60,6 +66,7 @@ from federated_pytorch_test_tpu.partition import (
     flatten_params,
 )
 from federated_pytorch_test_tpu.utils import (
+    Deferred,
     MetricsRecorder,
     checkpoint_path,
     load_checkpoint,
@@ -94,6 +101,14 @@ class Trainer:
         size must divide `cfg.n_clients`)."""
         self.cfg = cfg
         self.recorder = MetricsRecorder(verbose=verbose)
+
+        if cfg.compile_cache:
+            # persistent XLA executable cache (`--compile-cache DIR`):
+            # process-global jax config, set before any program below is
+            # built so the first compile already populates it
+            cache = os.path.abspath(cfg.compile_cache)
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
 
         if source is None:
             source = load_cifar(
@@ -259,7 +274,15 @@ class Trainer:
             self.shard_labels = _put(self.fed.train_labels, csh)
         self.mean = _put(self.fed.mean, csh)
         self.std = _put(self.fed.std, csh)
+        # the padded test sweep is staged as device-resident COMMITTED
+        # arrays exactly once, here: every eval — standalone program or
+        # folded into the fused round — reuses these buffers with zero
+        # per-eval host->device transfer (regression-tested under
+        # jax.transfer_guard in tests/test_fold_eval.py). The true test
+        # count is cached host-side too, so computing an accuracy from
+        # correct counts costs no device fetch of the mask.
         t_imgs, t_labels, t_mask = self._stack_test()
+        self._test_total = int(t_mask.sum())
         self.test_imgs = _put(t_imgs, rsh)
         self.test_labels = _put(t_labels, rsh)
         self.test_mask = _put(t_mask, rsh)
@@ -400,8 +423,14 @@ class Trainer:
         # excluded: pure output paths, and `resume` — the recovery switch
         # is exactly the knob a restarted run flips, and the trajectory it
         # continues is guarded by the checkpoint-marker alignment, not by
-        # config identity
-        for k in ("metrics_stream", "trace_out", "profile_dir", "resume"):
+        # config identity. `compile_cache` is an output-side path too, and
+        # `fold_eval`/`async_eval` are dispatch-shape knobs whose record
+        # streams are identical by contract (tests/test_fold_eval.py) —
+        # a resumed run may flip any of them and still splice.
+        for k in (
+            "metrics_stream", "trace_out", "profile_dir", "resume",
+            "compile_cache", "fold_eval", "async_eval",
+        ):
             d.pop(k, None)
         cfg_tag = hashlib.md5(
             json.dumps(d, sort_keys=True, default=repr).encode()
@@ -430,7 +459,13 @@ class Trainer:
         return jax.tree.map(lambda *xs: jnp.stack(xs), *vs)
 
     def _stack_test(self):
-        """Pad + stack the test sweep as [T,B,...] arrays for the eval scan."""
+        """Pad + stack the test sweep as HOST [T,B,...] arrays.
+
+        Stays numpy: the caller `_put`s the stack straight to its final
+        replicated sharding, one transfer — a `jnp.asarray` here would
+        first materialize an uncommitted copy on the default device and
+        then reshard it.
+        """
         b = self.cfg.eval_batch
         imgs, labels, masks = [], [], []
         for i, l, m in self.fed.test_batches(b):
@@ -438,9 +473,9 @@ class Trainer:
             labels.append(l)
             masks.append(m)
         return (
-            jnp.asarray(np.stack(imgs)),
-            jnp.asarray(np.stack(labels)),
-            jnp.asarray(np.stack(masks)),
+            np.stack(imgs),
+            np.stack(labels),
+            np.stack(masks),
         )
 
     def _ctx(self, gid: int) -> GroupContext:
@@ -527,16 +562,33 @@ class Trainer:
                 return False
         return True
 
+    def _fold_eval_enabled(self) -> bool:
+        """Whether the `check_results` eval cadence runs INSIDE the fused
+        round program (the default). Folding requires the fused round
+        itself (`_fused_enabled` is the whole fallback matrix — where
+        fusion stands down, eval was never inside a program to fold) plus
+        an eval cadence to fold (`check_results`) and the `fold_eval`
+        knob (`--no-fold-eval` is the escape hatch, which keeps the fused
+        round but evaluates its per-consensus snapshots outside)."""
+        return (
+            self._fused_enabled()
+            and self.cfg.check_results
+            and self.cfg.fold_eval
+        )
+
     def _round_fn(self, gid: int):
         if gid not in self._round_fns:
+            fold = self._fold_eval_enabled()
             self._round_fns[gid] = build_round_fn(
                 self._ctx(gid),
                 self.mesh,
                 nadmm=self.cfg.nadmm,
                 nepoch=self.cfg.nepoch,
-                # mid-round state only needs materializing when the
-                # per-consensus-round eval cadence will read it
-                snapshot=self.cfg.check_results,
+                # mid-round state only needs materializing when an
+                # OUTSIDE eval will read it; the folded eval consumes the
+                # post-consensus state inside the program instead
+                snapshot=self.cfg.check_results and not fold,
+                fold_eval=fold,
                 counter=self._dispatch,
             )
         return self._round_fns[gid]
@@ -608,13 +660,30 @@ class Trainer:
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
     def evaluate(self, flat=None, stats=None) -> np.ndarray:
-        """Per-client top-1 accuracy over the full test set.
+        """Per-client top-1 accuracy over the full test set, blocking.
+
+        The synchronous convenience wrapper (external callers, parity
+        harnesses): enqueue + immediate harvest. The training loop itself
+        uses `evaluate_deferred` so the host sync moves off the hot path.
+        """
+        return self.evaluate_deferred(flat, stats).resolve()
+
+    def evaluate_deferred(self, flat=None, stats=None) -> Deferred:
+        """Enqueue the jitted eval sweep NOW, defer the host harvest.
+
+        The dispatch is asynchronous: the device queue receives the eval
+        program (reading `flat`/`stats` AS OF THIS CALL — a later
+        rollback or donation cannot change what it computes) and the host
+        returns immediately with a `Deferred` whose resolution performs
+        the device->host fetch. The recorder harvests these at round
+        boundaries, always before a commit marker/checkpoint
+        (utils/metrics.py). With `async_eval=False` the fetch happens
+        here instead — the pre-async timing, identical records.
 
         `flat`/`stats` default to the trainer's live state; the fused
-        round path passes its per-consensus-round snapshots instead, so
-        the `check_results` eval cadence survives fusion.
+        `--no-fold-eval` path passes its per-consensus-round snapshots.
         """
-        with self.recorder.phase("eval", record=False):
+        with self.recorder.phase("eval_enqueue", record=False):
             correct = self.eval_fn(
                 self.flat if flat is None else flat,
                 self.stats if stats is None else stats,
@@ -624,8 +693,15 @@ class Trainer:
                 self.mean,
                 self.std,
             )
-            total = int(np.asarray(self.test_mask).sum())  # replicated: local
-            return self._fetch(correct) / total
+
+        def harvest():
+            with self.recorder.phase("eval_harvest", record=False):
+                return self._fetch(correct) / self._test_total
+
+        d = Deferred(harvest)
+        if not self.cfg.async_eval:
+            d.resolve()
+        return d
 
     def _check_losses(self, losses: np.ndarray, **ctx) -> None:
         """Per-epoch failure detection: a client whose losses went
@@ -840,10 +916,15 @@ class Trainer:
                     np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
                     NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
                 )
+                eval_args = (
+                    (self.test_imgs, self.test_labels, self.test_mask)
+                    if self._fold_eval_enabled()
+                    else ()
+                )
                 round_fn.lower(
                     self.flat, lstate, self.stats, self.shard_imgs,
                     self.shard_labels, idx, self.mean, self.std,
-                    y, z, rho, extra, masks,
+                    y, z, rho, extra, masks, *eval_args,
                 ).compile()
                 return time.perf_counter() - t0
             epoch_fn, consensus_fn, init_fn = self._fns(gid)
@@ -891,9 +972,17 @@ class Trainer:
         and continue from its entry state. Everything else a round
         produces (lstate, y, z) is re-initialized per round anyway. The
         snapshots are XLA-owned device copies — safe to adopt directly
-        (and to be donated by the next round's epoch fn)."""
+        (and to be donated by the next round's epoch fn).
+
+        The round's evals go with it: their records are still pending
+        (deferred, harvested only at the round boundary — after this),
+        so a discarded round contributes NO test_accuracy records, in
+        any eval mode (docs/FAULT.md §Rollback mode). The eval
+        computations themselves already ran against the poisoned state;
+        only their records are dropped."""
         if not self._round_poisoned:
             return
+        self.recorder.discard_pending("test_accuracy")
         snap_flat, snap_stats, snap_rho = snap
         self.flat = snap_flat
         self.stats = snap_stats
@@ -1045,7 +1134,7 @@ class Trainer:
                             )
                             rows.append(self._fetch(l_s)[0])
                             self.recorder.accuracies(
-                                self.evaluate(),
+                                self.evaluate_deferred(),
                                 nloop=nloop,
                                 group=gid,
                                 nadmm=nadmm,
@@ -1081,7 +1170,7 @@ class Trainer:
                     # reproduces that cadence exactly; per-epoch is the
                     # default because it keeps the epoch one computation)
                     self.recorder.accuracies(
-                        self.evaluate(),
+                        self.evaluate_deferred(),
                         nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch,
                     )
             if consensus_fn is not None:
@@ -1151,7 +1240,7 @@ class Trainer:
                 # duplicate of it
             ):
                 self.recorder.accuracies(
-                    self.evaluate(), nloop=nloop, group=gid, nadmm=nadmm
+                    self.evaluate_deferred(), nloop=nloop, group=gid, nadmm=nadmm
                 )
         if cfg.strategy == "admm":
             self._rho_store[gid] = rho
@@ -1180,9 +1269,14 @@ class Trainer:
           Rollback semantics are unchanged: the round was already
           transactional, and a poisoned round restores the entry
           snapshot wholesale;
-        * `check_results` evals run on the program's per-consensus-round
-          `(flat, stats)` snapshots, so the accuracy series keeps its
-          cadence; eval itself stays outside the fused program;
+        * the `check_results` eval cadence is FOLDED INTO the program by
+          default (`_fold_eval_enabled`): each consensus iteration's
+          full-test-sweep correct counts come back as a `[nadmm, K]`
+          round output — zero standalone eval dispatches, zero extra
+          host syncs, and the `[nadmm, K, N]` state snapshots are never
+          materialized. With `--no-fold-eval` the program snapshots its
+          per-consensus `(flat, stats)` instead and the standalone eval
+          program runs on them outside, deferred (`evaluate_deferred`);
         * planned crashes fire at their recorded round cursor, after the
           dispatch — the process exits and recovery replays from the
           checkpoint exactly as before (the device state a crashing
@@ -1232,6 +1326,12 @@ class Trainer:
             NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
         )
 
+        fold = self._fold_eval_enabled()
+        eval_args = (
+            (self.test_imgs, self.test_labels, self.test_mask)
+            if fold
+            else ()
+        )
         self._step_num += cfg.nadmm * cfg.nepoch
         with self.recorder.phase(
             "fused_round", nloop=nloop, group=gid
@@ -1239,16 +1339,19 @@ class Trainer:
             "fused_round", step_num=self._step_num
         ):
             (self.flat, lstate, self.stats, y, z, rho, extra,
-             losses_d, met, param_ok_d, snaps) = round_fn(
+             losses_d, met, param_ok_d, snaps, correct_d) = round_fn(
                 self.flat, lstate, self.stats, self.shard_imgs,
                 self.shard_labels, idx, self.mean, self.std,
-                y, z, rho, extra, masks,
+                y, z, rho, extra, masks, *eval_args,
             )
             # device->host fetch of an output is the completion barrier
             # (the telemetry series is needed host-side regardless)
             losses = self._fetch(losses_d)  # [nadmm, nepoch, S, K]
         param_ok = self._fetch(param_ok_d)  # [nadmm, K]
         dual, primal, mean_rho, survivors = (self._fetch(m) for m in met)
+        # the folded evals' correct counts ride the same completion
+        # barrier: one [nadmm, K] fetch covers every eval of the round
+        correct = self._fetch(correct_d) if fold else None
         is_admm = cfg.strategy == "admm"
 
         # host bookkeeping replay, in the unfused path's per-round order
@@ -1287,14 +1390,20 @@ class Trainer:
             if self.injector is not None:
                 self.injector.maybe_crash(nloop, gid, a)
             if cfg.check_results:
-                flat_snaps, stats_snaps = snaps
-                self.recorder.accuracies(
-                    self.evaluate(
+                if fold:
+                    # already computed inside the round program and
+                    # fetched above; Deferred keeps the record on the
+                    # same harvest/discard path as the outside evals
+                    acc = Deferred(
+                        lambda a=a: correct[a] / self._test_total
+                    )
+                else:
+                    flat_snaps, stats_snaps = snaps
+                    acc = self.evaluate_deferred(
                         flat=flat_snaps[a],
                         stats=jax.tree.map(lambda x: x[a], stats_snaps),
-                    ),
-                    nloop=nloop, group=gid, nadmm=a,
-                )
+                    )
+                self.recorder.accuracies(acc, nloop=nloop, group=gid, nadmm=a)
         if is_admm:
             self._rho_store[gid] = rho
         if rollback:

@@ -31,6 +31,12 @@ The JSONL line protocol (one JSON object per line):
   same path) or a missing restore-point marker abandons the old stream
   with a warning and starts fresh: splicing two different experiments'
   series would be worse than losing one.
+* DEFERRED records (async evals, utils/metrics.py Deferred) never reach
+  `record()` unresolved: the recorder queues them — and every streamed
+  record behind them, preserving order — until its round-boundary
+  harvest, and always resolves the queue BEFORE `commit(nloop)` writes a
+  marker. A leaked thunk would fail `json.dumps` loudly here rather than
+  corrupt a line.
 """
 
 from __future__ import annotations

@@ -3,8 +3,12 @@
 `jax.profiler.trace` (config.profile_dir) captures device timelines but
 needs TensorBoard tooling and profiles *programs*, not the trainer's loop
 nest. `TraceRecorder` is the complementary host-side view: every
-round/epoch/consensus/eval/compile region the trainer enters becomes one
-span in a Chrome trace-event JSON — drag the file into
+round/epoch/consensus/compile region the trainer enters becomes one
+span in a Chrome trace-event JSON. Evals appear as a SPLIT pair —
+`eval_enqueue` (the async program dispatch, inside its round's span) and
+`eval_harvest` (the deferred device->host fetch at the round-boundary
+flush, after the round span) — or not at all when they are folded into
+the fused round program (docs/OBSERVABILITY.md). Drag the file into
 https://ui.perfetto.dev (or chrome://tracing) and the whole experiment's
 nesting, stalls, and per-phase walls are a timeline. The span context
 managers are shared with the `step_time` metric calls

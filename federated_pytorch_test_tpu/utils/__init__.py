@@ -1,6 +1,6 @@
 """Cross-cutting utilities: metrics, checkpointing, profiling."""
 
-from federated_pytorch_test_tpu.utils.metrics import MetricsRecorder
+from federated_pytorch_test_tpu.utils.metrics import Deferred, MetricsRecorder
 from federated_pytorch_test_tpu.utils.checkpoint import (
     checkpoint_path,
     load_checkpoint,
@@ -14,6 +14,7 @@ from federated_pytorch_test_tpu.utils.hostcpu import (
 
 __all__ = [
     "compile_cache_dir",
+    "Deferred",
     "MetricsRecorder",
     "checkpoint_path",
     "load_checkpoint",

@@ -17,6 +17,16 @@ The store is extended by the `obs/` layer (docs/OBSERVABILITY.md):
   durability barriers, and `add_sink(..., replay=...)` seeds the
   in-memory series from a resumed stream so a crash+resume run's series
   is continuous;
+* **deferred records** — a record's value may be a `Deferred` (a thunk,
+  typically closing over a `jax.Array` whose device->host fetch is the
+  expensive part): the record takes its place in the series immediately,
+  but the value is materialized lazily — harvested in batch at the
+  trainer's round boundaries (`flush`) and ALWAYS before a
+  `commit_loop()` marker reaches the sinks, so the crash-safety contract
+  (everything before an `nloop_complete` marker is durable and complete)
+  holds with async evals exactly as with sync ones. While a deferred
+  record is pending, subsequent streamed records queue behind it, so the
+  sink stream stays record-for-record in logging order;
 * **tracer** — `phase()` is the ONE enter/exit context manager shared by
   the wall-clock `step_time` records and the Chrome-trace span recorder
   (`obs/trace.py`), so the timing series and the exported trace can never
@@ -31,7 +41,34 @@ import json
 import math
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Deferred:
+    """A lazily-materialized metric value.
+
+    Wraps a zero-arg thunk whose call is postponed until the record is
+    harvested (round boundary / commit / serialization). The thunk runs
+    at most once; `resolve()` returns the cached value afterwards. The
+    intended payload is a device array already ENQUEUED on the
+    accelerator — the dispatch happened at log time, only the blocking
+    device->host fetch is deferred — so rollback/late mutation of the
+    live training state cannot change what a deferred record reports.
+    """
+
+    __slots__ = ("_fn", "_value", "_resolved")
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+        self._value = None
+        self._resolved = False
+
+    def resolve(self) -> Any:
+        if not self._resolved:
+            self._value = self._fn()
+            self._fn = None  # drop the closure (and its device arrays)
+            self._resolved = True
+        return self._value
 
 
 @dataclasses.dataclass
@@ -54,18 +91,64 @@ class MetricsRecorder:
     sinks: List[Any] = dataclasses.field(default_factory=list)
     tracer: Optional[Any] = None
     _t0: float = dataclasses.field(default_factory=time.perf_counter)
+    # streamed records not yet forwarded to the sinks: a `Deferred` value
+    # holds its slot here until harvested, and every later streamed
+    # record queues BEHIND it so the sink stream preserves logging order
+    _pending: List[Tuple[str, dict]] = dataclasses.field(default_factory=list)
 
     def log(self, name: str, value: Any, *, stream: bool = True, **context) -> None:
         """Append one record; `stream=False` keeps it OUT of the sinks —
         for series that are facts about THIS PROCESS rather than the run's
         trajectory (`recompile_count`: a resumed process recompiles
         programs the crashed one had warm, so streaming it would break the
-        crash/resume stream-continuity contract)."""
+        crash/resume stream-continuity contract).
+
+        `value` may be a `Deferred`: the record enters the series now and
+        is materialized + forwarded to the sinks at the next harvest
+        (`flush`/`commit_loop`/serialization)."""
         rec = {"t": time.perf_counter() - self._t0, "value": value, **context}
         self.series.setdefault(name, []).append(rec)
         if stream:
+            if self._pending or isinstance(value, Deferred):
+                self._pending.append((name, rec))
+            else:
+                for s in self.sinks:
+                    s.record(name, rec)
+
+    def _harvest(self) -> None:
+        """Materialize every pending deferred value and forward the queued
+        records to the sinks, in logging order."""
+        pending, self._pending = self._pending, []
+        for name, rec in pending:
+            if isinstance(rec["value"], Deferred):
+                rec["value"] = rec["value"].resolve()
             for s in self.sinks:
                 s.record(name, rec)
+
+    def _materialize(self) -> None:
+        """Resolve every deferred value in the store IN PLACE (no sink
+        forwarding — pending records keep their queue slots and reach the
+        sinks, already resolved, at the next harvest)."""
+        for recs in self.series.values():
+            for rec in recs:
+                if isinstance(rec["value"], Deferred):
+                    rec["value"] = rec["value"].resolve()
+
+    def discard_pending(self, name: str) -> None:
+        """Drop the not-yet-harvested records of one series — from both
+        the sink queue and the in-memory store. The trainer's rollback
+        path uses this: a poisoned round is discarded wholesale, and its
+        enqueued (deferred) evals go with it — they never reach the
+        stream, in ANY eval mode (docs/FAULT.md §Rollback mode)."""
+        dropped = [rec for n, rec in self._pending if n == name]
+        self._pending = [(n, r) for n, r in self._pending if n != name]
+        if dropped and name in self.series:
+            drop_ids = {id(r) for r in dropped}
+            self.series[name] = [
+                r for r in self.series[name] if id(r) not in drop_ids
+            ]
+            if not self.series[name]:
+                del self.series[name]
 
     # ------------------------------------------------------ sinks & tracing
 
@@ -81,17 +164,25 @@ class MetricsRecorder:
         self.sinks.append(sink)
 
     def flush(self) -> None:
-        """Per-round durability: push buffered sink writes to the OS."""
+        """Per-round durability: harvest pending deferred records, then
+        push buffered sink writes to the OS."""
+        self._harvest()
         for s in self.sinks:
             s.flush()
 
     def commit_loop(self, nloop: int) -> None:
         """Checkpoint-boundary durability: marker + fsync in every sink.
-        The JSONL resume path truncates to these markers (obs/sinks.py)."""
+        Pending deferred records are ALWAYS resolved and written first —
+        the marker's contract (everything before it is durable and
+        complete) must hold for async evals too, or a crash+resume stream
+        would diverge from an uninterrupted one. The JSONL resume path
+        truncates to these markers (obs/sinks.py)."""
+        self._harvest()
         for s in self.sinks:
             s.commit(nloop)
 
     def close(self) -> None:
+        self._harvest()
         for s in self.sinks:
             s.close()
 
@@ -188,20 +279,34 @@ class MetricsRecorder:
         (src/federated_trio.py:199-223). `epoch`/`minibatch` are set on the
         per-batch cadence (`eval_every_batch`, the reference's
         check_results=True telemetry, src/no_consensus_trio.py:266-267).
+
+        `accs` may be a `Deferred` (the trainer's async eval path): the
+        record is logged now and materialized — including the verbose
+        per-client print, which then appears at harvest time instead of
+        inline — when the round's deferred records are harvested.
         """
-        vals = [float(a) for a in accs]
         ctx = dict(nloop=nloop, group=group, nadmm=nadmm)
         if epoch is not None:
             ctx["epoch"] = epoch
         if minibatch is not None:
             ctx["minibatch"] = minibatch
-        self.log("test_accuracy", vals, **ctx)
-        if self.verbose:
-            for k, a in enumerate(vals):
-                print(
-                    f"Accuracy of client {k + 1} on the test images: "
-                    f"{100.0 * a:.2f} %"
-                )
+
+        def emit(raw):
+            vals = [float(a) for a in raw]
+            if self.verbose:
+                for k, a in enumerate(vals):
+                    print(
+                        f"Accuracy of client {k + 1} on the test images: "
+                        f"{100.0 * a:.2f} %"
+                    )
+            return vals
+
+        if isinstance(accs, Deferred):
+            self.log(
+                "test_accuracy", Deferred(lambda: emit(accs.resolve())), **ctx
+            )
+        else:
+            self.log("test_accuracy", emit(accs), **ctx)
 
     def step_time(self, phase: str, seconds: float, **context) -> None:
         """Wall-clock duration of one phase (epoch / consensus / eval).
@@ -256,15 +361,22 @@ class MetricsRecorder:
             )
 
     def latest(self, name: str):
-        return self.series[name][-1]["value"] if self.series.get(name) else None
+        if not self.series.get(name):
+            return None
+        rec = self.series[name][-1]
+        if isinstance(rec["value"], Deferred):
+            rec["value"] = rec["value"].resolve()
+        return rec["value"]
 
     def to_json(self) -> str:
         """The full store as JSON: `{"series": ..., "first_nonfinite": ...}`.
 
         The envelope carries the poisoned-round cursor alongside the
         series — a bare-series dump would lose exactly the record a
-        post-mortem of a `--metrics-out` file needs.
+        post-mortem of a `--metrics-out` file needs. Deferred values are
+        materialized first (a thunk is not JSON).
         """
+        self._materialize()
         return json.dumps(
             {"series": self.series, "first_nonfinite": self.first_nonfinite}
         )

@@ -5,15 +5,22 @@
 #                                    mesh: kernels, consensus math,
 #                                    collectives, fault-plan purity,
 #                                    obs units (JSONL sink truncation,
-#                                    comm-ledger arithmetic, trace JSON)
+#                                    comm-ledger arithmetic, trace JSON,
+#                                    deferred-record queue mechanics)
 #   tier 1  pytest -m 'not slow'   — the DEFAULT budgeted gate (the
 #                                    driver's verify command): smoke plus
 #                                    the middle tier (partition, models,
 #                                    trainer-level chaos, fused-round
 #                                    bit-identity, crash/resume metric-
 #                                    stream continuity, dispatch/trace
-#                                    integration — tests/test_obs.py),
-#                                    ~5 min
+#                                    integration — tests/test_obs.py —
+#                                    and the eval-tail contracts: the
+#                                    folded-round dispatch-budget gate
+#                                    (dispatch_count == {round:1,
+#                                    round_init:1}), cross-eval-mode
+#                                    stream identity, rollback eval
+#                                    discard — tests/test_fold_eval.py),
+#                                    ~7 min
 #   tier 2  pytest -m slow         — full integration (~20+ min): engine
 #                                    sweeps, resnet-engine runs,
 #                                    streaming-equivalence, Pallas

@@ -354,7 +354,12 @@ def test_trace_out_nested_round_epoch_consensus_spans(unfused_run):
     by_name = {}
     for e in evs:
         by_name.setdefault(e["name"], []).append(e)
-    assert {"round", "epoch", "consensus", "eval"} <= set(by_name)
+    # the eval span is SPLIT (docs/OBSERVABILITY.md): enqueue (the async
+    # dispatch, inside the round) vs harvest (the deferred device->host
+    # fetch, at the round-boundary flush — outside the round span)
+    assert {
+        "round", "epoch", "consensus", "eval_enqueue", "eval_harvest"
+    } <= set(by_name)
 
     def inside(inner, outer):
         return (
@@ -363,8 +368,11 @@ def test_trace_out_nested_round_epoch_consensus_spans(unfused_run):
         )
 
     rnd = by_name["round"][0]
-    for name in ("epoch", "consensus"):
+    for name in ("epoch", "consensus", "eval_enqueue"):
         assert all(inside(e, rnd) for e in by_name[name]), name
+    # every enqueued eval is harvested, after its enqueue
+    assert len(by_name["eval_harvest"]) == len(by_name["eval_enqueue"])
+    assert by_name["eval_harvest"][0]["ts"] >= by_name["eval_enqueue"][0]["ts"]
     # span context keys survive into args (greppable in Perfetto)
     assert by_name["epoch"][0]["args"]["nadmm"] == 0
 
