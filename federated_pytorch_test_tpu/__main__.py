@@ -21,11 +21,17 @@ boundaries (`--no-async-eval` restores the blocking per-eval fetch).
 backend compilation.
 
 Chaos runs (fault/, docs/FAULT.md) ride the same config surface:
-`--fault-plan "seed=1,dropout=0.3,crash=0:1:2"` (or a FaultPlan JSON
-path) injects replayable dropout/straggler/crash faults, and
-`--resume auto --save-model` makes a crashed run recover from the latest
-readable checkpoint on restart. An injected crash exits non-zero with
-the InjectedCrash message; rerunning the identical command resumes.
+`--fault-plan "seed=1,dropout=0.3,crash=0:1:2,corrupt=1:scale:10"` (or
+a FaultPlan JSON path, parsed strictly) injects replayable dropout/
+straggler/crash/update-corruption faults, and `--resume auto
+--save-model` makes a crashed run recover from the latest readable
+checkpoint on restart. An injected crash exits non-zero with the
+InjectedCrash message; rerunning the identical command resumes.
+Byzantine defense: `--robust-agg median|trimmed|clip` (+ `--robust-f`)
+makes the consensus exchange tolerate corrupted updates instead of
+averaging them in, and `--quarantine-z Z` auto-quarantines update-norm
+outliers for the rest of their round; the end-of-run summary gains a
+`# faults injected:` scoreboard and a quarantine-waste comm line.
 
 Observability (obs/, docs/OBSERVABILITY.md) rides it too:
 `--metrics-stream run.jsonl` streams every metric record to a crash-safe
@@ -69,6 +75,8 @@ def _add_config_flags(parser: argparse.ArgumentParser) -> None:
             typ = {"int": int, "float": float}.get(ts, str)
             if "int | None" in ts:
                 typ = int  # flag absent => None; given => parsed as int
+            elif "float | None" in ts:
+                typ = float  # same contract (e.g. --quarantine-z)
             parser.add_argument(flag, dest=f.name, type=typ, default=None)
 
 
@@ -95,6 +103,22 @@ def _print_summary(recorder, cfg) -> None:
                 f"(uplink/floor {comm['vs_data_floor']})"
             )
         print(line)
+    inj = recorder.latest("injected_faults")
+    if inj is not None:
+        # the chaos scoreboard: scheduled kinds come from the pure plan
+        # (fault/injector.py injected_summary — a resumed run prints the
+        # same totals); the quarantine count is a detection and survives
+        # resume only via a replayed --metrics-stream
+        order = ("drops", "stragglers", "crashes", "corruptions", "quarantines")
+        print(
+            "# faults injected: "
+            + ", ".join(f"{k}={inj[k]}" for k in order if k in inj)
+        )
+    if comm and comm.get("bytes_quarantined_wasted"):
+        print(
+            f"# quarantine waste: {comm['bytes_quarantined_wasted']:,} B "
+            "uplink transmitted by quarantined clients and discarded"
+        )
     disp: dict = {}
     for r in recorder.series.get("dispatch_count", []):
         for k, v in r["value"].items():

@@ -23,16 +23,26 @@ from federated_pytorch_test_tpu.consensus.fedavg import (
     fedavg_round,
 )
 from federated_pytorch_test_tpu.consensus.penalties import elastic_net, soft_threshold
+from federated_pytorch_test_tpu.consensus.robust import (
+    ROBUST_METHODS,
+    apply_corruption,
+    robust_combine,
+    update_suspects,
+)
 
 __all__ = [
     "ADMMConfig",
     "ADMMState",
     "FedAvgState",
+    "ROBUST_METHODS",
     "admm_init",
     "admm_penalty",
     "admm_round",
+    "apply_corruption",
     "elastic_net",
     "fedavg_init",
     "fedavg_round",
+    "robust_combine",
     "soft_threshold",
+    "update_suspects",
 ]

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from federated_pytorch_test_tpu.consensus.penalties import soft_threshold
+from federated_pytorch_test_tpu.consensus.robust import robust_combine
 from federated_pytorch_test_tpu.parallel import client_count, client_sum, weighted_client_mean
 
 
@@ -145,6 +146,9 @@ def admm_round(
     nadmm: jnp.ndarray,
     config: ADMMConfig,
     mask: Optional[jnp.ndarray] = None,
+    x_agg: Optional[jnp.ndarray] = None,
+    combine: str = "mean",
+    robust_f: int = 0,
 ) -> Tuple[ADMMState, ADMMMetrics]:
     """BB adaptation (if due) + z-update + y-update for one ADMM iteration.
 
@@ -162,6 +166,20 @@ def admm_round(
     mask every select picks the unmasked operand and every product is a
     multiplication by 1.0, so the result is BIT-IDENTICAL to the unmasked
     path (tests/test_fault.py).
+
+    `x_agg` is the aggregation's VIEW of each client's x — what the
+    exchange received, which under an injected corruption fault differs
+    from what the client holds (fault/plan.py: corruption is in transit).
+    Only the z-update consumes it; the client-local math (BB adaptation,
+    y-update, primal residual) keeps the true `x_local` — a Byzantine
+    client lies to the server, not to itself. Defaults to `x_local`
+    (identical graph, so clean runs are untouched).
+
+    `combine` selects the z-update: 'mean' (the reference's ρ-weighted
+    psum, untouched) or a robust order statistic over `v = y/ρ + x`
+    ('median' / 'trimmed' with `robust_f` per side / 'clip';
+    consensus/robust.py — unweighted across survivors, a documented
+    deviation from the ρ-weighting).
     """
     n = x_local.shape[-1]
     k = client_count(x_local)
@@ -192,17 +210,35 @@ def admm_round(
 
     # z-update: weighted mean with v = y/rho + x, w = rho so that
     # sum(v*w)/sum(w) == sum(y + rho*x)/sum(rho) (reference :502); under a
-    # mask the weight becomes rho*m — surviving clients only
-    if part is None:
-        znew = weighted_client_mean(state.y / rho + x_local, rho)
+    # mask the weight becomes rho*m — surviving clients only. The update
+    # entering the exchange is the RECEIVED one (x_agg — corrupted in
+    # transit under a corruption fault); everything client-local above
+    # and below uses the true x_local.
+    xz = x_local if x_agg is None else x_agg
+    if combine == "mean":
+        if part is None:
+            znew = weighted_client_mean(state.y / rho + xz, rho)
+        else:
+            w = rho * part.astype(x_local.dtype)
+            num = client_sum((state.y / rho + xz) * w)
+            den = client_sum(w)
+            znew = num / jnp.where(den > 0, den, 1.0)
     else:
-        w = rho * part.astype(x_local.dtype)
-        num = client_sum((state.y / rho + x_local) * w)
-        den = client_sum(w)
-        znew = num / jnp.where(den > 0, den, 1.0)
+        m = (
+            mask
+            if mask is not None
+            else jnp.ones((x_local.shape[0],), x_local.dtype)
+        ).astype(x_local.dtype)
+        znew, usable = robust_combine(
+            state.y / rho + xz, m, combine, trim_f=robust_f, prev=state.z
+        )
     if config.z_soft_threshold > 0.0:
         znew = soft_threshold(znew, config.z_soft_threshold)
-    if part is not None:
+    if combine != "mean":
+        # per-coordinate keep-previous AFTER the soft threshold — an
+        # unusable coordinate keeps z exactly (consensus/robust.py)
+        znew = jnp.where(usable, znew, state.z)
+    if part is not None or combine != "mean":
         znew = jnp.where(survivors > 0, znew, state.z)
     dual = jnp.linalg.norm(state.z - znew) / n
 

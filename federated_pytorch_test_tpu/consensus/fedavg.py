@@ -19,6 +19,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax.numpy as jnp
 
 from federated_pytorch_test_tpu.consensus.penalties import soft_threshold
+from federated_pytorch_test_tpu.consensus.robust import robust_combine
 from federated_pytorch_test_tpu.parallel import client_count, client_mean, client_sum
 
 
@@ -36,6 +37,8 @@ def fedavg_round(
     state: FedAvgState,
     z_soft_threshold: float = 0.0,
     mask: Optional[jnp.ndarray] = None,
+    combine: str = "mean",
+    robust_f: int = 0,
 ) -> Tuple[FedAvgState, dict]:
     """One averaging round over the local client block `[K_loc, N]`.
 
@@ -53,19 +56,42 @@ def fedavg_round(
     reports `survivors == 0`. With the all-ones mask every operation is
     multiplication by 1.0 and division by the identical psum'd K, so the
     result is BIT-IDENTICAL to the unmasked path (tests/test_fault.py).
+
+    `combine` selects the aggregation: 'mean' (the reference's, above —
+    its code path is untouched so no-chaos runs stay bit-identical) or a
+    Byzantine-robust order statistic from consensus/robust.py ('median',
+    'trimmed' with `robust_f` trimmed per side, 'clip') that tolerates
+    corrupted updates instead of averaging them in (docs/FAULT.md).
     """
     n = x_local.shape[-1]
-    if mask is None:
-        znew = client_mean(x_local)
-        survivors = client_count(x_local)
+    if combine == "mean":
+        if mask is None:
+            znew = client_mean(x_local)
+            survivors = client_count(x_local)
+        else:
+            m = mask.astype(x_local.dtype)
+            survivors = client_sum(m)
+            safe = jnp.where(survivors > 0, survivors, 1.0)
+            znew = client_sum(x_local * m[:, None]) / safe
     else:
-        m = mask.astype(x_local.dtype)
+        m = (
+            mask
+            if mask is not None
+            else jnp.ones((x_local.shape[0],), x_local.dtype)
+        ).astype(x_local.dtype)
         survivors = client_sum(m)
-        safe = jnp.where(survivors > 0, survivors, 1.0)
-        znew = client_sum(x_local * m[:, None]) / safe
+        znew, usable = robust_combine(
+            x_local, m, combine, trim_f=robust_f, prev=state.z
+        )
     if z_soft_threshold > 0.0:
         znew = soft_threshold(znew, z_soft_threshold)
-    if mask is not None:
+    if combine != "mean":
+        # per-coordinate keep-previous AFTER the soft threshold: an
+        # unusable coordinate (every survivor non-finite) keeps z
+        # EXACTLY, not a shrunk copy — the all-dropped invariant's
+        # corruption mirror (consensus/robust.py)
+        znew = jnp.where(usable, znew, state.z)
+    if mask is not None or combine != "mean":
         znew = jnp.where(survivors > 0, znew, state.z)
     dual = jnp.linalg.norm(state.z - znew) / n
     return FedAvgState(z=znew), {"dual_residual": dual, "survivors": survivors}

@@ -1,0 +1,268 @@
+"""Byzantine-robust aggregation: corruption model, robust combiners,
+auto-quarantine statistics.
+
+The participation mask (fault/plan.py) defends against *absent* clients;
+this module defends against *present but lying* ones. FedADMM
+(arXiv:2204.03529) argues consensus aggregation should absorb client
+misbehavior when the combiner is robust, and TAMUNA (arXiv:2302.09832)
+treats partial participation as an algorithmic regime — the same applies
+to partial *trust*: tolerate up to `f` corrupted updates per round
+instead of poisoning the consensus variable or sacrificing the whole
+round to the rollback machinery.
+
+Three pieces, all pure SPMD functions over the local client block (the
+same calling convention as consensus/fedavg.py, consensus/admm.py):
+
+* `apply_corruption` — the fault model's on-device half: given the
+  plan's `[K]` mode/strength/seed rows (fault/plan.py `corruption`),
+  corrupt the chosen clients' updates IN TRANSIT. Mode 0 selects the
+  input bits verbatim, so a corruption-capable program with an all-clean
+  row is bit-identical to the clean program.
+* `robust_combine` — masked coordinate-wise **median**, **trimmed-mean
+  (f per side)**, and **norm-clipping** combiners with the same
+  shape contract as the masked mean (`[K_loc, N]` + `[K_loc]` mask ->
+  `[N]`). Order statistics need every client's value per coordinate, so
+  these pay one `all_gather` over the clients axis — the one place the
+  bandwidth contract is deliberately spent on integrity (mean keeps its
+  psum).
+* `update_suspects` — the auto-quarantine statistic: per-client update
+  norms `‖x_k − z‖` and their cross-client z-scores; a non-finite or
+  outlying update flags its sender as suspect, and the trainer ANDs the
+  accumulated suspect mask into the NEXT exchange's participation mask
+  (quarantine is round-scoped — a persistently Byzantine client is
+  re-detected each partition round from the same deterministic
+  evidence).
+
+Robustness contract of the order-statistic combiners: a NON-FINITE value
+is self-evident corruption and is excluded per coordinate BEFORE the
+order statistics (a NaN needs no voting to reject — and counting it as
+a cohort member would bias the trim window onto the wrong finite value:
+with 3 survivors and one NaN burst, trimmed(1) would otherwise
+systematically pick the larger honest value instead of their middle).
+The trim then guards against the plausible-but-wrong values — `trimmed`
+tolerates up to `f` arbitrarily scaled/flipped survivors per round,
+`median` just under half; an exchange whose every update is non-finite
+keeps the previous consensus state. The rollback machinery stays the
+last resort, not the only defense.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from federated_pytorch_test_tpu.parallel import CLIENT_AXIS
+from federated_pytorch_test_tpu.parallel.collectives import (
+    all_clients,
+    client_sum,
+)
+
+ROBUST_METHODS = ("mean", "median", "trimmed", "clip")
+
+
+# ------------------------------------------------------- corruption model
+
+
+def apply_corruption(
+    x_local: jnp.ndarray,
+    modes: jnp.ndarray,
+    strengths: jnp.ndarray,
+    seeds: jnp.ndarray,
+    gauss: bool = True,
+) -> jnp.ndarray:
+    """Corrupt chosen clients' updates in transit (`[K_loc, N]` -> same).
+
+    `modes [K_loc]` i32 uses fault/plan.py's CORRUPT_MODES codes
+    (0 = clean — selects the input bits verbatim, so an all-clean row is
+    bit-transparent); `strengths [K_loc]` is λ for scale, σ for gauss;
+    `seeds [K_loc]` i32 feed the gauss mode's deterministic on-device
+    noise draw (pure in the plan seed + round cursor, so fused and
+    unfused chaos runs corrupt identically).
+
+    `gauss` is a STATIC build flag: under vmap the batched-predicate
+    switch lowers to computing every branch and selecting, so a plan
+    that never schedules gauss (a single `corrupt_mode` per plan) should
+    pass False and compile the PRNG draw out of the hot program instead
+    of paying a per-client `[N]` normal draw every exchange.
+    """
+
+    def one(xk, mk, sk, seedk):
+        branches = [
+            lambda _: xk,  # 0: clean
+            lambda _: xk * sk,  # 1: scale ×λ
+            lambda _: -xk,  # 2: signflip
+            lambda _: jnp.full_like(xk, jnp.nan),  # 3: nan_burst
+            (
+                (
+                    lambda _: xk
+                    + sk
+                    * jax.random.normal(  # 4: gauss σ·N(0,1)
+                        jax.random.PRNGKey(seedk), xk.shape, xk.dtype
+                    )
+                )
+                if gauss
+                else (lambda _: xk)  # mode 4 unreachable in this plan
+            ),
+        ]
+        return lax.switch(jnp.clip(mk, 0, len(branches) - 1), branches, 0)
+
+    return jax.vmap(one)(x_local, modes, strengths, seeds)
+
+
+# -------------------------------------------------------- robust combiners
+
+
+def _sorted_finite_survivors(v_local, m, axis_name):
+    """All-gathered `[K, N]` values sorted ascending per coordinate, with
+    dropped clients AND non-finite entries pushed to +inf, plus the
+    per-coordinate finite-survivor count `[N]`. The usable cohort
+    occupies the sorted prefix — non-finite values are self-evident
+    corruption, excluded before any order statistic (module docstring)."""
+    all_v = all_clients(v_local, axis_name)
+    all_m = all_clients(m, axis_name)
+    ok = (all_m[:, None] > 0) & jnp.isfinite(all_v)  # [K, N]
+    vals = jnp.where(ok, all_v, jnp.inf)
+    return jnp.sort(vals, axis=0), jnp.sum(ok.astype(jnp.int32), axis=0)
+
+
+def _prefix_median(sv, cnt):
+    """Coordinate-wise median of each column's first `cnt[j]` sorted rows."""
+    lo = jnp.maximum(cnt - 1, 0) // 2  # [N]
+    hi = jnp.maximum(cnt, 1) // 2
+    take = lambda i: jnp.take_along_axis(sv, i[None, :], axis=0)[0]
+    return 0.5 * (take(lo) + take(hi))
+
+
+def robust_combine(
+    v_local: jnp.ndarray,
+    mask: jnp.ndarray,
+    method: str,
+    *,
+    trim_f: int = 0,
+    prev: jnp.ndarray | None = None,
+    axis_name: str = CLIENT_AXIS,
+):
+    """Masked robust cross-client combine: `[K_loc, N]` ->
+    `(combined [N], usable [N] bool)`.
+
+    `usable` marks coordinates with at least one finite surviving value;
+    where it is False, `combined` already holds `prev` — but callers
+    must ALSO re-select `prev` on `~usable` after any downstream
+    transform (fedavg_round/admm_round apply it after the soft
+    threshold), or an all-unusable exchange would shrink the kept
+    consensus state instead of keeping it exactly, breaking the
+    all-dropped-round invariant's corruption mirror.
+
+    * `median` — coordinate-wise median over the finite survivors.
+    * `trimmed` — drop the `trim_f` largest and smallest values per
+      coordinate among the finite survivors, mean the rest; falls back
+      to the median where `finite survivors <= 2*trim_f` leaves nothing
+      to average.
+    * `clip` — norm-clipping around `prev`: each survivor's update
+      `v_k − prev` is shrunk onto the ball of radius τ = median of the
+      finite survivors' update norms, then averaged; non-finite updates
+      are excluded entirely (a NaN cannot be clipped back to honesty).
+
+    ADMM note: the mean z-update weights clients by ρ_k; the robust
+    combiners are unweighted order statistics (a Byzantine client could
+    inflate its own weight otherwise), which is a documented deviation —
+    with uniform ρ the two coincide.
+    """
+    if method not in ROBUST_METHODS or method == "mean":
+        raise ValueError(
+            f"robust_combine handles {[m for m in ROBUST_METHODS if m != 'mean']}, "
+            f"got {method!r} (the mean lives in fedavg_round/admm_round)"
+        )
+    m = mask.astype(v_local.dtype)
+
+    if method in ("median", "trimmed"):
+        assert prev is not None, "order statistics need the fallback vector"
+        sv, cnt = _sorted_finite_survivors(v_local, m, axis_name)
+        median = _prefix_median(sv, cnt)
+        if method == "median":
+            combined = median
+        else:
+            idx = jnp.arange(sv.shape[0], dtype=jnp.int32)
+            # per-coordinate trim window over the finite prefix
+            keep = (idx[:, None] >= trim_f) & (idx[:, None] < cnt[None, :] - trim_f)
+            # where-guard BEFORE the multiply: the excluded slots hold
+            # +infs (dropped / non-finite), and inf*0 would poison the
+            # sum the trim exists to protect
+            kept = jnp.where(keep, sv, 0.0)
+            denom = jnp.maximum(cnt - 2 * trim_f, 1).astype(v_local.dtype)
+            trimmed = jnp.sum(kept, axis=0) / denom
+            combined = jnp.where(cnt > 2 * trim_f, trimmed, median)
+        # a coordinate with NO usable value (every survivor non-finite)
+        # keeps the previous consensus state
+        usable = cnt > 0
+        return jnp.where(usable, combined, prev), usable
+
+    # norm-clipping around the previous consensus state
+    assert prev is not None, "clip needs the previous consensus vector"
+    d = v_local - prev[None, :]
+    norms = jnp.sqrt(jnp.sum(d * d, axis=-1))  # [K_loc]
+    ok = m * jnp.isfinite(norms).astype(v_local.dtype)
+    n_ok = client_sum(ok, axis_name=axis_name)
+    all_n = all_clients(norms, axis_name)
+    all_ok = all_clients(ok, axis_name)
+    sn = jnp.sort(jnp.where(all_ok > 0, all_n, jnp.inf))
+    tau = _prefix_median(sn[:, None], n_ok.astype(jnp.int32)[None])[0]
+    factor = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-30))
+    clipped = prev[None, :] + d * factor[:, None]
+    contrib = jnp.where(ok[:, None] > 0, clipped, 0.0)
+    combined = client_sum(contrib, axis_name=axis_name) / jnp.maximum(n_ok, 1.0)
+    usable = jnp.broadcast_to(n_ok > 0, combined.shape)
+    return jnp.where(usable, combined, prev), usable
+
+
+# --------------------------------------------------------- auto-quarantine
+
+
+def update_suspects(
+    v_local: jnp.ndarray,
+    prev: jnp.ndarray,
+    mask: jnp.ndarray,
+    z_thresh,
+    axis_name: str = CLIENT_AXIS,
+):
+    """Per-client update norms + outlier flags: `([K_loc], [K_loc])`.
+
+    `u_k = ‖v_k − prev‖` is the magnitude of the update client k sent
+    this exchange; its z-score is computed over the alive, finite-update
+    cohort. Suspect iff alive AND (non-finite update, OR
+    `|u_k − mean| > z_thresh·std + ε` with a finite-update COHORT of at
+    least 3 — the judged client included — to define the statistic; in a
+    smaller cohort an "outlier" is unidentifiable and nobody is flagged
+    on norm evidence alone).
+
+    Small-cohort note: the z-score uses the population std (÷N), under
+    which a single outlier among K alive clients cannot exceed `√(K−1)`
+    (≈1.41 at K=3 — exactly attained when the honest cohort agrees), so
+    thresholds near 1.0 — not the folkloric 2.5–3 — are the operating
+    range for trio-sized experiments. `z_thresh = 0` is the hair
+    trigger: any deviation from the cohort mean is suspect (the
+    all-quarantined degenerate case the tests pin).
+    """
+    d = v_local - prev[None, :]
+    u = jnp.sqrt(jnp.sum(d * d, axis=-1))  # [K_loc]
+    m = mask.astype(u.dtype)
+    finite = jnp.isfinite(u)
+    ok = m * finite.astype(u.dtype)
+    n_ok = client_sum(ok, axis_name=axis_name)
+    safe = jnp.maximum(n_ok, 1.0)
+    uz = jnp.where(ok > 0, u, 0.0)
+    mean = client_sum(uz, axis_name=axis_name) / safe
+    var = (
+        client_sum(jnp.where(ok > 0, (u - mean) ** 2, 0.0), axis_name=axis_name)
+        / safe
+    )
+    std = jnp.sqrt(var)
+    # ε floors keep an all-equal cohort (std == 0) from flagging ulp noise
+    outlier = jnp.abs(u - mean) > (
+        z_thresh * std + 1e-12 + 1e-6 * jnp.abs(mean)
+    )
+    suspect = m * jnp.where(
+        (~finite) | (outlier & (n_ok >= 3.0)), 1.0, 0.0
+    ).astype(u.dtype)
+    return u, suspect

@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Tuple
 
-from federated_pytorch_test_tpu.consensus import ADMMConfig
+from federated_pytorch_test_tpu.consensus import ADMMConfig, ROBUST_METHODS
 from federated_pytorch_test_tpu.optim import LBFGSConfig
 
 
@@ -242,13 +242,37 @@ class ExperimentConfig:
     fault_mode: str = "warn"
 
     # failure INJECTION (fault/plan.py): a path to a FaultPlan JSON file
-    # or an inline spec like "seed=1,dropout=0.3,crash=0:1:2". Dropped
-    # clients are excluded from consensus via the participation mask,
-    # stragglers stall the round host-side, and crash points raise
-    # InjectedCrash at the named round boundary (recover with
-    # resume='auto'). None = no chaos; every fault is a pure function of
-    # (plan seed, round cursor), so chaos runs replay exactly.
+    # or an inline spec like "seed=1,dropout=0.3,crash=0:1:2,
+    # corrupt=1:scale:10". Dropped clients are excluded from consensus
+    # via the participation mask, stragglers stall the round host-side,
+    # crash points raise InjectedCrash at the named round boundary
+    # (recover with resume='auto'), and corruption faults garble chosen
+    # clients' updates in transit before the exchange. None = no chaos;
+    # every fault is a pure function of (plan seed, round cursor), so
+    # chaos runs replay exactly.
     fault_plan: str | None = None
+
+    # Byzantine-robust aggregation (consensus/robust.py, docs/FAULT.md):
+    # how the consensus exchange combines the surviving clients' updates.
+    # 'mean' is the reference's participation-masked average (untouched
+    # code path — bit-identical to pre-robust runs); 'median'/'trimmed'/
+    # 'clip' are order-statistic combiners that tolerate up to
+    # `robust_f` corrupted updates per round instead of averaging them
+    # into the consensus variable (or tripping the rollback machinery).
+    robust_agg: str = "mean"
+    # clients trimmed per SIDE by the 'trimmed' combiner (tolerates f
+    # Byzantine clients per round; needs n_clients > 2f). Ignored by the
+    # other combiners.
+    robust_f: int = 1
+    # auto-quarantine threshold: flag a client whose update norm's
+    # cross-client z-score exceeds this (or whose update is non-finite)
+    # and exclude it from the REST OF THE ROUND's exchanges — the suspect
+    # mask ANDs into the participation mask, round-scoped. None = off.
+    # Small-cohort note: with K alive clients a single outlier's
+    # population-std z-score cannot exceed sqrt(K-1) (~1.41 at K=3), so
+    # thresholds near 1.0 are the operating range for trio-sized runs;
+    # 0 is the hair trigger.
+    quarantine_z: float | None = None
 
     # 'auto': restore the latest READABLE checkpoint under checkpoint_dir
     # if one exists, else start fresh — the crash-recovery switch a chaos
@@ -319,6 +343,27 @@ class ExperimentConfig:
         if self.diagnostics_every is not None and self.diagnostics_every < 1:
             raise ValueError(
                 f"diagnostics_every must be >= 1, got {self.diagnostics_every}"
+            )
+        if self.robust_agg not in ROBUST_METHODS:
+            raise ValueError(
+                f"robust_agg must be one of {list(ROBUST_METHODS)}, "
+                f"got {self.robust_agg!r}"
+            )
+        if self.robust_f < 0:
+            raise ValueError(f"robust_f must be >= 0, got {self.robust_f}")
+        if (
+            self.robust_agg == "trimmed"
+            and self.n_clients <= 2 * self.robust_f
+        ):
+            raise ValueError(
+                f"trimmed-mean with robust_f={self.robust_f} trims "
+                f"{2 * self.robust_f} of n_clients={self.n_clients} "
+                "updates per round — nothing would remain to average "
+                "(need n_clients > 2*robust_f)"
+            )
+        if self.quarantine_z is not None and self.quarantine_z < 0:
+            raise ValueError(
+                f"quarantine_z must be >= 0, got {self.quarantine_z}"
             )
 
     def lbfgs_config(self) -> LBFGSConfig:

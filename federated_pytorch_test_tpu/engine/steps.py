@@ -61,9 +61,11 @@ from federated_pytorch_test_tpu.consensus import (
     admm_init,
     admm_penalty,
     admm_round,
+    apply_corruption,
     elastic_net,
     fedavg_init,
     fedavg_round,
+    update_suspects,
 )
 from federated_pytorch_test_tpu.data import normalize
 from federated_pytorch_test_tpu.optim import (
@@ -137,6 +139,23 @@ class GroupContext(NamedTuple):
     # diagnostic forward, for comparison tests and telemetry that must
     # match pre-round-5 runs bitwise (config.fold_diag_forward)
     fold_diag: bool = True
+    # Byzantine-robust aggregation (consensus/robust.py): which combiner
+    # the consensus exchange uses ('mean' keeps the reference math,
+    # untouched) and the trimmed-mean per-side trim count
+    robust_agg: str = "mean"
+    robust_f: int = 0
+    # auto-quarantine z-score threshold; None disables the update-norm
+    # statistics entirely (the consensus program is then unchanged)
+    quarantine_z: Optional[float] = None
+    # the fault plan schedules update corruption: the consensus body
+    # takes the per-round [K] mode/strength/seed rows and corrupts the
+    # chosen updates in transit. Static so corruption-free runs compile
+    # the exact pre-corruption programs.
+    corrupt: bool = False
+    # whether the plan's single corrupt_mode is 'gauss' — static, so
+    # non-gauss plans compile the per-client PRNG draw out of the hot
+    # program (a vmapped switch evaluates every branch)
+    corrupt_gauss: bool = True
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
@@ -463,19 +482,45 @@ def _consensus_local(ctx: GroupContext):
     """The per-device consensus body, shared by the standalone consensus
     program (`build_consensus_fn`) and the fused round (`build_round_fn`).
 
-    `(flat, y, z, rho, extra, nadmm, mask) -> (flat, y, z, rho, extra,
-    (dual, primal, mean_rho, survivors))`. Returns None for strategy
-    'none' (independent training has no consensus exchange).
+    `(flat, y, z, rho, extra, nadmm, mask[, cmode, cstr, cseed]) ->
+    (flat, y, z, rho, extra, (dual, primal, mean_rho, survivors),
+    qstats)`. The corruption args exist only when `ctx.corrupt` (the
+    plan schedules update corruption — static, so corruption-free runs
+    compile the pre-corruption program); `qstats` is `(unorm, suspect)`
+    — the auto-quarantine update-norm statistics — when
+    `ctx.quarantine_z` is set, else `()`. `mask` is the EFFECTIVE
+    participation vector (plan dropout AND any quarantine accumulated by
+    the caller). Returns None for strategy 'none' (independent training
+    has no consensus exchange).
     """
     if ctx.strategy == "none":
         return None
+    quarantine = ctx.quarantine_z is not None
+
+    def send_view(x, corr):
+        """The aggregation's view of the updates: corrupted in transit
+        when the plan says so (mode 0 selects the true bits verbatim)."""
+        if not ctx.corrupt:
+            return x
+        return apply_corruption(x, *corr, gauss=ctx.corrupt_gauss)
+
+    def qstats_of(x_send, z_prev, mask):
+        if not quarantine:
+            return ()
+        return update_suspects(x_send, z_prev, mask, ctx.quarantine_z)
 
     if ctx.strategy == "fedavg":
 
-        def local(flat, y, z, rho, extra, nadmm, mask):
+        def local(flat, y, z, rho, extra, nadmm, mask, *corr):
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
+            x_send = send_view(x, corr)
             state, met = fedavg_round(
-                x, FedAvgState(z=z), ctx.admm.z_soft_threshold, mask=mask
+                x_send,
+                FedAvgState(z=z),
+                ctx.admm.z_soft_threshold,
+                mask=mask,
+                combine=ctx.robust_agg,
+                robust_f=ctx.robust_f,
             )
             flat = jax.vmap(
                 lambda f, mk: ctx.partition.insert(
@@ -490,21 +535,31 @@ def _consensus_local(ctx: GroupContext):
                 zeros,
                 zeros,
                 met["survivors"],
-            )
+            ), qstats_of(x_send, z, mask)
 
     else:  # admm
 
-        def local(flat, y, z, rho, extra, nadmm, mask):
+        def local(flat, y, z, rho, extra, nadmm, mask, *corr):
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
+            x_send = send_view(x, corr)
             yhat0, x0 = extra
             state = ADMMState(y=y, z=z, rho=rho, yhat0=yhat0, x0=x0)
-            state, met = admm_round(x, state, nadmm, ctx.admm, mask=mask)
+            state, met = admm_round(
+                x,
+                state,
+                nadmm,
+                ctx.admm,
+                mask=mask,
+                x_agg=x_send if ctx.corrupt else None,
+                combine=ctx.robust_agg,
+                robust_f=ctx.robust_f,
+            )
             return flat, state.y, state.z, state.rho, (state.yhat0, state.x0), (
                 met.dual_residual,
                 met.primal_residual,
                 met.mean_rho,
                 met.survivors,
-            )
+            ), qstats_of(x_send, z, mask)
 
     return local
 
@@ -517,12 +572,21 @@ def build_consensus_fn(ctx: GroupContext, mesh, counter=None):
     weighted z-update, y-update; clients keep their own x (reference
     src/consensus_admm_trio.py:395-513).
 
-    `mask` is the `[K]` participation vector of the round (fault/plan.py;
+    `mask` is the `[K]` EFFECTIVE participation vector of the round
+    (fault/plan.py dropout AND any quarantine the trainer accumulated;
     all-ones when no fault plan is active — bit-identical to the unmasked
     math). FedAvg's broadcast-back honors it too: a dropped client missed
     the round, so it keeps its own x instead of receiving znew and rejoins
     from stale parameters — the partial-participation regime of TAMUNA
     (arXiv:2302.09832). Metrics gain the psum'd survivor count.
+
+    With `ctx.corrupt` the signature grows the round's `[K]` corruption
+    mode/strength/seed rows (fault/injector.py) and the exchange consumes
+    the in-transit-corrupted updates; with `ctx.quarantine_z` the
+    returned `qstats` tuple carries the `[K]` update norms and suspect
+    flags the trainer folds into the NEXT exchange's mask
+    (consensus/robust.py; both empty/absent otherwise — the clean
+    program is unchanged).
     """
     local = _consensus_local(ctx)
     if local is None:
@@ -530,11 +594,15 @@ def build_consensus_fn(ctx: GroupContext, mesh, counter=None):
 
     c = P(CLIENT_AXIS)
     r = P()
+    in_specs = (c, c, r, c, (c, c), r, c)
+    if ctx.corrupt:
+        in_specs = in_specs + (c, c, c)
+    qspec = (c, c) if ctx.quarantine_z is not None else ()
     sharded = shard_map(
         local,
         mesh=mesh,
-        in_specs=(c, c, r, c, (c, c), r, c),
-        out_specs=(c, c, r, c, (c, c), (r, r, r, r)),
+        in_specs=in_specs,
+        out_specs=(c, c, r, c, (c, c), (r, r, r, r), qspec),
         check_vma=True,
     )
     # no donation here: the round-init placeholders alias buffers (e.g.
@@ -607,13 +675,15 @@ def build_round_fn(
        shard_labels [K,n], idx [nadmm, nepoch, S, K, B],
        mean [K], std [K], y [K,G], z [G], rho [K,1], extra,
        masks [nadmm, K]
+       [, cmodes [nadmm, K] i32, cstrengths [nadmm, K], cseeds
+          [nadmm, K] i32 — static `ctx.corrupt` only]
        [, test_imgs [T,B,...], test_labels [T,B], test_mask [T,B]
           — static `fold_eval=True` only])
       -> (flat, lstate, stats, y, z, rho, extra,
           losses [nadmm, nepoch, S, K],
           met (dual, primal, mean_rho, survivors) each [nadmm],
           param_ok [nadmm, K] bool,
-          snaps, correct)
+          qstats, snaps, correct)
 
     * `idx` is the whole round's shuffle schedule, precomputed host-side
       (the trainer stacks its deterministic per-(nadmm, epoch)
@@ -621,6 +691,19 @@ def build_round_fn(
     * `masks [nadmm, K]` are the per-consensus-round participation masks
       (fault/injector.py `masks_for_round`), scan xs; all-ones without a
       fault plan — bit-identical to the maskless math.
+    * `cmodes`/`cstrengths`/`cseeds` (static `ctx.corrupt` only) are the
+      round's corruption schedule (fault/injector.py
+      `corruption_for_round`), scan xs: each consensus iteration's
+      exchange sees the in-transit-corrupted updates
+      (consensus/robust.py `apply_corruption`) while the clients keep
+      their true parameters.
+    * `qstats` (static `ctx.quarantine_z` only, else `()`): the
+      auto-quarantine statistics `(update_norm [nadmm, K], suspect
+      [nadmm, K])`. The suspect mask accumulates IN-CARRY and ANDs into
+      the following exchanges' participation masks — the quarantine
+      decision happens inside the one dispatch, no host round-trip; the
+      host reads the matrices once per round for telemetry and the comm
+      ledger's wasted-uplink attribution.
     * `param_ok` is the `fault_mode` parameter check as on-device flags:
       per-client post-consensus finiteness, accumulated across the scan
       and inspected ONCE per round by the host (the rollback round is
@@ -660,13 +743,29 @@ def build_round_fn(
         else None
     )
 
+    corrupt = ctx.corrupt and consensus_local is not None
+    quarantine = (
+        ctx.quarantine_z is not None and consensus_local is not None
+    )
+
     def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
-              y, z, rho, extra, masks,
-              test_imgs=None, test_labels=None, test_mask=None):
+              y, z, rho, extra, masks, *rest):
+        # *rest, by static flags: [cmodes, cstrengths, cseeds] when the
+        # plan schedules corruption, then [test_imgs, test_labels,
+        # test_mask] when the eval is folded
+        rest = list(rest)
+        corr_rows = tuple(rest[:3]) if corrupt else ()
+        if corrupt:
+            rest = rest[3:]
+        test_imgs, test_labels, test_mask = (
+            rest if fold_eval else (None, None, None)
+        )
 
         def round_body(carry, xs):
-            flat, lstate, stats, y, z, rho, extra = carry
-            idx_a, mask_a, na = xs  # [nepoch, S, K_loc, B], [K_loc], i32
+            flat, lstate, stats, y, z, rho, extra, qmask = carry
+            # [nepoch, S, K_loc, B], [K_loc], i32, per-iteration [K_loc]
+            # corruption rows
+            idx_a, mask_a, na, corr_a = xs
             # replicated consensus vector -> varying for the closed-over
             # L-BFGS while_loop (see build_epoch_fn); the CARRY keeps the
             # unvarying z so its type is stable across scan iterations
@@ -698,15 +797,23 @@ def build_round_fn(
             losses = losses.reshape((nepoch, s) + losses.shape[1:])
 
             if consensus_local is not None:
-                flat, y, z, rho, extra, met = consensus_local(
-                    flat, y, z, rho, extra, na, mask_a
+                # quarantine ANDs into the plan mask: a client flagged at
+                # an earlier exchange of THIS round is excluded here
+                eff_mask = mask_a * qmask if quarantine else mask_a
+                flat, y, z, rho, extra, met, qstats = consensus_local(
+                    flat, y, z, rho, extra, na, eff_mask, *corr_a
                 )
             else:
                 zeros = jnp.zeros((), flat.dtype)
                 met = (zeros, zeros, zeros, zeros)
+                qstats = ()
             param_ok = jnp.isfinite(flat).all(axis=tuple(range(1, flat.ndim)))
 
             ys = (losses, met, param_ok)
+            if quarantine:
+                unorm, suspect = qstats
+                qmask = qmask * (1.0 - suspect)
+                ys = ys + ((unorm, suspect),)
             if snapshot:
                 ys = ys + ((flat, stats),)
             if fold_eval:
@@ -717,17 +824,27 @@ def build_round_fn(
                     client_eval, in_axes=(0, 0, None, None, None, 0, 0)
                 )(flat, stats, test_imgs, test_labels, test_mask, mean, std)
                 ys = ys + (correct,)
-            return (flat, lstate, stats, y, z, rho, extra), ys
+            return (flat, lstate, stats, y, z, rho, extra, qmask), ys
 
-        carry = (flat, lstate, stats, y, z, rho, extra)
+        # the quarantine carry starts all-clear; derived from the varying
+        # masks input so its vma type matches the suspect-driven updates
+        qmask0 = jnp.ones_like(masks[0]) if quarantine else ()
+        carry = (flat, lstate, stats, y, z, rho, extra, qmask0)
         na_seq = jnp.arange(nadmm, dtype=jnp.int32)
-        carry, ys = lax.scan(round_body, carry, (idx, masks, na_seq))
-        flat, lstate, stats, y, z, rho, extra = carry
+        # corr_rows is () without corruption — a leafless xs entry whose
+        # per-step slice stays (), so one scan call serves both builds
+        carry, ys = lax.scan(
+            round_body, carry, (idx, masks, na_seq, corr_rows)
+        )
+        flat, lstate, stats, y, z, rho, extra, _ = carry
         losses, met, param_ok = ys[:3]
-        snaps = ys[3] if snapshot else ()
+        i = 3
+        qstats = (ys[i][0], ys[i][1]) if quarantine else ()
+        i += 1 if quarantine else 0
+        snaps = ys[i] if snapshot else ()
         correct = ys[-1] if fold_eval else ()
         return (flat, lstate, stats, y, z, rho, extra,
-                losses, met, param_ok, snaps, correct)
+                losses, met, param_ok, qstats, snaps, correct)
 
     c = P(CLIENT_AXIS)
     r = P()
@@ -738,6 +855,8 @@ def build_round_fn(
         c, c, c, r, c, (c, c),
         sc1,  # masks [nadmm, K]
     )
+    if corrupt:
+        in_specs = in_specs + (sc1, sc1, sc1)  # corruption mode/str/seed
     if fold_eval:
         in_specs = in_specs + (r, r, r)  # replicated [T,B,...] test sweep
     out_specs = (
@@ -745,6 +864,7 @@ def build_round_fn(
         P(None, None, None, CLIENT_AXIS),  # losses [nadmm, nepoch, S, K]
         (r, r, r, r),  # per-nadmm metric series
         sc1,  # param_ok [nadmm, K]
+        (sc1, sc1) if quarantine else (),  # update norms + suspect flags
         (sc1, sc1) if snapshot else (),  # post-consensus state snapshots
         sc1 if fold_eval else (),  # folded-eval correct counts [nadmm, K]
     )

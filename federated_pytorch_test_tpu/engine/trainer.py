@@ -372,7 +372,12 @@ class Trainer:
             # resumed WITHOUT a stream to absorb (no metrics_stream, or
             # the stream was abandoned): the skipped loops' traffic is
             # still exactly recomputable — masks are pure in (plan seed,
-            # round cursor) — so the comm summary covers the whole run
+            # round cursor) — so the comm summary covers the whole run.
+            # (Total bytes count TRANSMITTING clients, i.e. plan
+            # survivors, so this holds under quarantine too; only the
+            # skipped loops' wasted-bytes attribution needs the model's
+            # update norms and is not reconstructed here — resume with a
+            # stream to keep it.)
             for nloop in range(self._completed_nloops):
                 for gid in self.group_order:
                     for a in range(cfg.nadmm):
@@ -513,6 +518,37 @@ class Trainer:
             # refresh: models with batch stats always run it
             diag_forward=cfg.diag_forward or self.has_stats,
             fold_diag=cfg.fold_diag_forward,
+            robust_agg=cfg.robust_agg,
+            robust_f=cfg.robust_f,
+            # exchange-bound defenses only exist where an exchange does
+            quarantine_z=(
+                cfg.quarantine_z if self._quarantine_enabled() else None
+            ),
+            corrupt=self._corruption_enabled(),
+            corrupt_gauss=(
+                self._corruption_enabled()
+                and self.injector.plan.corrupt_mode == "gauss"
+            ),
+        )
+
+    def _quarantine_enabled(self) -> bool:
+        return (
+            self.cfg.quarantine_z is not None
+            and self.cfg.strategy != "none"
+        )
+
+    def _corruption_enabled(self) -> bool:
+        """Whether the consensus programs carry the corruption inputs.
+
+        ONE definition on purpose: this predicate fixes the compiled
+        programs' argument signature (GroupContext.corrupt) AND gates
+        whether every call site passes the corruption rows — a drifted
+        copy would be an argument-count mismatch at dispatch time.
+        """
+        return (
+            self.injector is not None
+            and self.injector.has_corruption
+            and self.cfg.strategy != "none"
         )
 
     def _fns(self, gid: int):
@@ -744,6 +780,27 @@ class Trainer:
                 )
             self._round_poisoned = True
 
+    def _record_quarantine(
+        self, qstats, qmask_np: np.ndarray, *, nloop, group, nadmm
+    ) -> np.ndarray:
+        """Record one exchange's auto-quarantine statistics and fold the
+        new suspects into the round's quarantine mask (both trainer
+        paths; consensus/robust.py `update_suspects` computed them on
+        device). `qstats` is a pair of HOST `[K]` arrays — callers
+        `_fetch` first (the fused path fetches its whole `[nadmm, K]`
+        matrices once and slices). Returns the updated `[K]` qmask
+        (1 = trusted)."""
+        unorm, suspect = qstats
+        u = np.asarray(unorm)
+        s = np.asarray(suspect, np.float32)
+        self.recorder.update_norms(u, nloop=nloop, group=group, nadmm=nadmm)
+        flagged = np.where(s > 0)[0]
+        if flagged.size:
+            self.recorder.quarantine(
+                flagged, nloop=nloop, group=group, nadmm=nadmm
+            )
+        return qmask_np * (1.0 - s)
+
     def _local_clients(self) -> list:
         """Global client ids whose mesh devices belong to THIS process.
 
@@ -905,6 +962,7 @@ class Trainer:
                 "epochs compile per-chunk shapes at first use instead"
             )
         with self.recorder.phase("compile", record=False, group=gid):
+            ctx_corrupt = self._corruption_enabled()
             if self._fused_enabled():
                 # the hot program of a fused run IS the round program:
                 # lower it against the real round arguments and stop —
@@ -912,10 +970,19 @@ class Trainer:
                 round_fn = self._round_fn(gid)
                 lstate, y, z, rho, extra = self._init_fn(gid)(self.flat)
                 idx = self._round_indices(0, gid)
+                sh = NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS))
                 masks = self._put(
                     np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
-                    NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
+                    sh,
                 )
+                corr_args = ()
+                if ctx_corrupt:
+                    shape = (self.cfg.nadmm, self.cfg.n_clients)
+                    corr_args = (
+                        self._put(np.zeros(shape, np.int32), sh),
+                        self._put(np.ones(shape, np.float32), sh),
+                        self._put(np.zeros(shape, np.int32), sh),
+                    )
                 eval_args = (
                     (self.test_imgs, self.test_labels, self.test_mask)
                     if self._fold_eval_enabled()
@@ -924,7 +991,7 @@ class Trainer:
                 round_fn.lower(
                     self.flat, lstate, self.stats, self.shard_imgs,
                     self.shard_labels, idx, self.mean, self.std,
-                    y, z, rho, extra, masks, *eval_args,
+                    y, z, rho, extra, masks, *corr_args, *eval_args,
                 ).compile()
                 return time.perf_counter() - t0
             epoch_fn, consensus_fn, init_fn = self._fns(gid)
@@ -945,8 +1012,18 @@ class Trainer:
                     self.shard_labels, sl, self.mean, self.std, y, z, rho,
                 ).compile()
             if consensus_fn is not None:
+                corr_args = ()
+                if ctx_corrupt:
+                    csh = client_sharding(self.mesh)
+                    k = self.cfg.n_clients
+                    corr_args = (
+                        self._put(np.zeros(k, np.int32), csh),
+                        self._put(np.ones(k, np.float32), csh),
+                        self._put(np.zeros(k, np.int32), csh),
+                    )
                 consensus_fn.lower(
-                    self.flat, y, z, rho, extra, jnp.int32(0), self._full_mask
+                    self.flat, y, z, rho, extra, jnp.int32(0),
+                    self._full_mask, *corr_args,
                 ).compile()
             return time.perf_counter() - t0
 
@@ -1091,6 +1168,12 @@ class Trainer:
         if cfg.strategy == "admm" and gid in self._rho_store:
             rho = self._rho_store[gid]  # carry BB-adapted rho across loops
         gsize = self.partition.group_size(gid)
+        corrupt = self._corruption_enabled()
+        quarantine = self._quarantine_enabled()
+        # the round-scoped quarantine mask (1 = trusted): suspects flagged
+        # at one exchange are excluded from the round's later exchanges —
+        # the host-side twin of the fused round's in-carry qmask
+        qmask_np = np.ones(cfg.n_clients, np.float32)
 
         for nadmm in range(cfg.nadmm):
             for epoch in range(cfg.nepoch):
@@ -1174,7 +1257,7 @@ class Trainer:
                         nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch,
                     )
             if consensus_fn is not None:
-                mask = self._full_mask
+                m_np = np.ones(cfg.n_clients, np.float32)
                 if self.injector is not None:
                     m_np = self.injector.mask(nloop, gid, nadmm)
                     delay = self.injector.straggler_delay(nloop, gid, nadmm)
@@ -1190,15 +1273,36 @@ class Trainer:
                             nadmm=nadmm,
                         )
                         time.sleep(delay)
-                    if m_np.sum() < self.cfg.n_clients:
-                        mask = self._put(
-                            m_np, client_sharding(self.mesh)
-                        )
+                # quarantined clients still transmit (they don't know);
+                # the exchange just discards their contribution
+                quarantined_now = (
+                    int((m_np * (1.0 - qmask_np)).sum()) if quarantine else 0
+                )
+                eff_np = m_np * qmask_np if quarantine else m_np
+                mask = (
+                    self._full_mask
+                    if eff_np.sum() >= self.cfg.n_clients
+                    else self._put(
+                        eff_np.astype(np.float32), client_sharding(self.mesh)
+                    )
+                )
+                corr_args = ()
+                if corrupt:
+                    cm, cs, csd = self.injector.plan.corruption(
+                        cfg.n_clients, nloop, gid, nadmm
+                    )
+                    csh = client_sharding(self.mesh)
+                    corr_args = (
+                        self._put(cm, csh),
+                        self._put(cs, csh),
+                        self._put(csd, csh),
+                    )
                 with self.recorder.phase(
                     "consensus", nloop=nloop, group=gid, nadmm=nadmm
                 ), jax.profiler.TraceAnnotation("consensus"):
-                    self.flat, y, z, rho, extra, met = consensus_fn(
-                        self.flat, y, z, rho, extra, jnp.int32(nadmm), mask
+                    self.flat, y, z, rho, extra, met, qstats = consensus_fn(
+                        self.flat, y, z, rho, extra, jnp.int32(nadmm), mask,
+                        *corr_args,
                     )
                     dual, primal, mean_rho, survivors = (
                         self._fetch(m) for m in met
@@ -1222,10 +1326,18 @@ class Trainer:
                         nadmm=nadmm,
                     )
                 # exact communicated bytes of this exchange (obs/ledger.py):
-                # the active group's coordinates, participating clients only
+                # the active group's coordinates, every TRANSMITTING
+                # client — plan survivors; a quarantined client's bytes
+                # still cross the wire and are attributed as wasted
                 self._comm.record(
-                    self.recorder, gid, int(survivors), nloop=nloop, nadmm=nadmm
+                    self.recorder, gid, int(m_np.sum()),
+                    nloop=nloop, nadmm=nadmm, quarantined=quarantined_now,
                 )
+                if quarantine:
+                    qmask_np = self._record_quarantine(
+                        (self._fetch(qstats[0]), self._fetch(qstats[1])),
+                        qmask_np, nloop=nloop, group=gid, nadmm=nadmm,
+                    )
             if check:
                 self._check_params(nloop=nloop, group=gid, nadmm=nadmm)
             if self.injector is not None:
@@ -1325,6 +1437,17 @@ class Trainer:
             masks_np,
             NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
         )
+        corrupt = self._corruption_enabled()
+        corr_args = ()
+        if corrupt:
+            sh = NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS))
+            corr_args = tuple(
+                self._put(arr, sh)
+                for arr in self.injector.corruption_for_round(
+                    nloop, gid, cfg.nadmm
+                )
+            )
+        quarantine = self._quarantine_enabled()
 
         fold = self._fold_eval_enabled()
         eval_args = (
@@ -1339,10 +1462,10 @@ class Trainer:
             "fused_round", step_num=self._step_num
         ):
             (self.flat, lstate, self.stats, y, z, rho, extra,
-             losses_d, met, param_ok_d, snaps, correct_d) = round_fn(
+             losses_d, met, param_ok_d, qstats_d, snaps, correct_d) = round_fn(
                 self.flat, lstate, self.stats, self.shard_imgs,
                 self.shard_labels, idx, self.mean, self.std,
-                y, z, rho, extra, masks, *eval_args,
+                y, z, rho, extra, masks, *corr_args, *eval_args,
             )
             # device->host fetch of an output is the completion barrier
             # (the telemetry series is needed host-side regardless)
@@ -1353,6 +1476,16 @@ class Trainer:
         # barrier: one [nadmm, K] fetch covers every eval of the round
         correct = self._fetch(correct_d) if fold else None
         is_admm = cfg.strategy == "admm"
+        # quarantine replay state: the in-carry decision already happened
+        # on device; qmask_np re-derives each exchange's trusted set so
+        # the host bookkeeping (wasted-uplink attribution) matches it.
+        # The [nadmm, K] statistic matrices are fetched ONCE here — the
+        # per-round read steps.py's docstring promises — and the replay
+        # loop below slices host arrays only.
+        qmask_np = np.ones(cfg.n_clients, np.float32)
+        if quarantine:
+            qnorm_m = self._fetch(qstats_d[0])  # [nadmm, K]
+            qsusp_m = self._fetch(qstats_d[1])
 
         # host bookkeeping replay, in the unfused path's per-round order
         for a in range(cfg.nadmm):
@@ -1379,10 +1512,23 @@ class Trainer:
                         nloop=nloop, group=gid, nadmm=a,
                     )
                 # same comm accounting as the unfused path, one record per
-                # consensus iteration of the fused scan (obs/ledger.py)
-                self._comm.record(
-                    self.recorder, gid, int(survivors[a]), nloop=nloop, nadmm=a
+                # consensus iteration of the fused scan (obs/ledger.py):
+                # every transmitting (plan-alive) client's bytes, with a
+                # quarantined sender's attributed as wasted
+                quarantined_now = (
+                    int((masks_np[a] * (1.0 - qmask_np)).sum())
+                    if quarantine
+                    else 0
                 )
+                self._comm.record(
+                    self.recorder, gid, int(masks_np[a].sum()),
+                    nloop=nloop, nadmm=a, quarantined=quarantined_now,
+                )
+                if quarantine:
+                    qmask_np = self._record_quarantine(
+                        (qnorm_m[a], qsusp_m[a]), qmask_np,
+                        nloop=nloop, group=gid, nadmm=a,
+                    )
             if check:
                 self._check_param_flags(
                     param_ok[a], nloop=nloop, group=gid, nadmm=a
@@ -1462,6 +1608,33 @@ class Trainer:
                 self.save(step=self._completed_nloops)
         if cfg.save_model:
             self.save(step=cfg.nloop)
+        # end-of-run injected-fault totals (CLI `# faults injected:`
+        # line): drawn from the PURE plan over the full round schedule —
+        # resume-proof, unlike execution counters — plus the quarantines
+        # the defense actually fired (a detection, so recorder-sourced:
+        # resume-proof only when a metrics stream replays the pre-crash
+        # records; without one the count covers the re-run loops only)
+        if self.injector is not None or "quarantine" in self.recorder.series:
+            counts = (
+                self.injector.injected_summary(
+                    cfg.nloop,
+                    self.group_order,
+                    cfg.nadmm,
+                    exchanges=cfg.strategy != "none",
+                )
+                if self.injector is not None
+                else {"drops": 0, "stragglers": 0, "crashes": 0,
+                      "corruptions": 0}
+            )
+            counts["quarantines"] = sum(
+                len(r["value"]["clients"])
+                for r in self.recorder.series.get("quarantine", [])
+            )
+            # stream=False: derivable from the plan at any time, and the
+            # crash count is exactly the field a crashed-and-resumed
+            # twin's plan legitimately differs in — streaming it would
+            # break the stream-identity contract for no information
+            self.recorder.log("injected_faults", counts, stream=False)
         # end-of-run communication summary: partial-parameter exchange vs
         # the hypothetical full-model exchange vs the ship-the-data floor
         self.recorder.log("comm_summary", self._comm.summary())

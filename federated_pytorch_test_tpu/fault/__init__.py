@@ -3,24 +3,30 @@
 The subsystem has three parts, stitched into the engine by `Trainer`:
 
 * `FaultPlan` (plan.py) — a deterministic, seeded schedule of client
-  dropouts, straggler delays, and crash points; every fault is a pure
-  function of (seed, round cursor), so chaos runs replay exactly;
-* `FaultInjector` (injector.py) — the runtime shim: mask/delay lookup
-  plus fire-once crash sentinels persisted next to the checkpoints;
+  dropouts, straggler delays, crash points, and update-corruption
+  events; every fault is a pure function of (seed, round cursor), so
+  chaos runs replay exactly;
+* `FaultInjector` (injector.py) — the runtime shim: mask/delay/corruption
+  lookup plus fire-once crash sentinels persisted next to the
+  checkpoints;
 * participation-masked aggregation lives with the consensus math it
-  guards (consensus/fedavg.py, consensus/admm.py — the `mask` argument).
+  guards (consensus/fedavg.py, consensus/admm.py — the `mask` argument),
+  and the Byzantine-robust combiners + auto-quarantine that defend
+  against corruption live in consensus/robust.py.
 
 See docs/FAULT.md for the replay/resume guarantees.
 """
 
 from federated_pytorch_test_tpu.fault.injector import FaultInjector
 from federated_pytorch_test_tpu.fault.plan import (
+    CORRUPT_MODES,
     CrashPoint,
     FaultPlan,
     InjectedCrash,
 )
 
 __all__ = [
+    "CORRUPT_MODES",
     "CrashPoint",
     "FaultInjector",
     "FaultPlan",

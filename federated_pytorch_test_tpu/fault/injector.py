@@ -33,6 +33,17 @@ class FaultInjector:
     ):
         self.plan = plan
         self.n_clients = n_clients
+        if plan.corrupt_k > n_clients:
+            # the plan alone cannot know K; validated here, where it
+            # meets the run — silently capping would corrupt EVERY
+            # client every round and overwhelm any trimmed-f defense
+            # while the operator believes k were configured (the same
+            # silently-wrong-plan class the strict JSON loader rejects)
+            raise ValueError(
+                f"fault plan's corrupt_k={plan.corrupt_k} exceeds "
+                f"n_clients={n_clients}: cannot corrupt more clients "
+                "than exist per round"
+            )
         self.state_dir = os.path.abspath(state_dir) if state_dir else None
         # sentinels are scoped to THIS plan: a different plan sharing the
         # checkpoint dir (new seed, new crash schedule) must not have its
@@ -71,6 +82,70 @@ class FaultInjector:
         return np.stack(
             [self.mask(nloop, gid, a) for a in range(nadmm)]
         ).astype(np.float32)
+
+    @property
+    def has_corruption(self) -> bool:
+        """Whether the plan schedules update corruption at all — the
+        static build flag: corruption-free runs compile the exact
+        pre-corruption consensus programs (engine/steps.py)."""
+        return self.plan.has_corruption
+
+    def corruption_for_round(self, nloop: int, gid: int, nadmm: int):
+        """`([nadmm, K] modes, [nadmm, K] strengths, [nadmm, K] seeds)`.
+
+        The whole round's corruption schedule, stacked like
+        `masks_for_round` so the fused round program consumes each
+        consensus iteration's row as scan inputs — no host round-trips,
+        and fused/unfused chaos runs replay the identical corruption.
+        """
+        rows = [
+            self.plan.corruption(self.n_clients, nloop, gid, a)
+            for a in range(nadmm)
+        ]
+        return (
+            np.stack([r[0] for r in rows]),
+            np.stack([r[1] for r in rows]),
+            np.stack([r[2] for r in rows]),
+        )
+
+    def injected_summary(
+        self, nloops: int, group_order, nadmm: int, exchanges: bool = True
+    ) -> dict:
+        """Fault counts over the experiment's full round schedule.
+
+        Pure in the plan (every fault is a function of seed + cursor), so
+        a crashed-and-resumed run reports the same totals as an
+        uninterrupted one — no execution-history counters to lose.
+        `exchanges=False` zeroes the exchange-bound kinds — dropout,
+        corruption, AND stragglers (the coordinator stalls waiting out a
+        slow client's exchange, so the trainer serves no stall without
+        one) — for strategy-'none' runs, which hold no consensus
+        exchange to apply them to; only the crash schedule fires either
+        way. Feeds the CLI's end-of-run `# faults injected:` line.
+        """
+        drops = stragglers = crashes = corruptions = 0
+        for nloop in range(nloops):
+            for gid in group_order:
+                for a in range(nadmm):
+                    if exchanges:
+                        mask = self.plan.participation(
+                            self.n_clients, nloop, gid, a
+                        )
+                        drops += int(self.n_clients - mask.sum())
+                        modes, _, _ = self.plan.corruption(
+                            self.n_clients, nloop, gid, a
+                        )
+                        corruptions += int((modes != 0).sum())
+                        if self.plan.straggler_delay(nloop, gid, a) > 0:
+                            stragglers += 1
+                    if self.plan.crash_at(nloop, gid, a) is not None:
+                        crashes += 1
+        return {
+            "drops": drops,
+            "stragglers": stragglers,
+            "crashes": crashes,
+            "corruptions": corruptions,
+        }
 
     def straggler_delays_for_round(
         self, nloop: int, gid: int, nadmm: int

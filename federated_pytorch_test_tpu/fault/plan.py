@@ -16,7 +16,7 @@ wall-clock, host count, or how often the run crashed and resumed — the
 "resumed run replays the exact trajectory" invariant of
 `utils/checkpoint.py` extends to injected faults (docs/FAULT.md).
 
-Three fault kinds:
+Four fault kinds:
 
 * **dropout** — each client independently misses a consensus round with
   probability `dropout_p` (it trains locally but its contribution is
@@ -26,7 +26,16 @@ Three fault kinds:
   seconds with probability `straggler_p` (the coordinator waiting out a
   slow client before declaring it dropped);
 * **crashes** — the process raises `InjectedCrash` at a named round
-  boundary, exercising checkpoint/resume (`--resume auto`).
+  boundary, exercising checkpoint/resume (`--resume auto`);
+* **corruption** — a chosen client's post-epoch update is corrupted IN
+  TRANSIT before the consensus exchange (Byzantine behavior: the
+  client's own local state keeps its true parameters; only the update
+  the aggregation sees is garbage). Modes: `scale` (×λ), `signflip`,
+  `nan_burst` (the whole update NaN), `gauss` (additive σ·N(0,1) noise).
+  The schedule is emitted like the dropout masks — `[nadmm, K]`
+  mode/strength/seed arrays the fused round consumes as scan inputs
+  (engine/steps.py) — and the defense lives in consensus/robust.py
+  (`--robust-agg median|trimmed|clip`, auto-quarantine).
 """
 
 from __future__ import annotations
@@ -41,6 +50,12 @@ import numpy as np
 
 class InjectedCrash(RuntimeError):
     """A planned crash point fired (see FaultPlan.crashes)."""
+
+
+# Corruption-mode codes, shared with the on-device application
+# (consensus/robust.py apply_corruption's lax.switch branch order).
+# 0 is reserved for "no corruption this round".
+CORRUPT_MODES = {"scale": 1, "signflip": 2, "nan_burst": 3, "gauss": 4}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,9 +85,32 @@ class FaultPlan:
     straggler_p: float = 0.0
     straggler_delay_s: float = 0.0
     crashes: Tuple[CrashPoint, ...] = ()
+    # corruption: either EXACTLY `corrupt_k` clients per round (chosen by
+    # the round's rng; the Byzantine-f regime the robust combiners are
+    # sized against) or each client independently with `corrupt_p`.
+    # `corrupt_strength` is λ for `scale`, σ for `gauss` (ignored by
+    # `signflip`/`nan_burst`).
+    corrupt_p: float = 0.0
+    corrupt_k: int = 0
+    corrupt_mode: str = "scale"
+    corrupt_strength: float = 10.0
 
     def __post_init__(self):
-        for name in ("dropout_p", "straggler_p"):
+        # types FIRST, so a wrong-typed field (a JSON plan with
+        # corrupt_k: 2.5 or dropout_p: "0.3") fails HERE naming the
+        # field, not rounds later inside numpy with an opaque TypeError
+        for name in ("seed", "corrupt_k"):
+            v = getattr(self, name)
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"{name} must be an int, got {v!r}")
+        for name in (
+            "dropout_p", "straggler_p", "straggler_delay_s",
+            "corrupt_p", "corrupt_strength",
+        ):
+            v = getattr(self, name)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"{name} must be a number, got {v!r}")
+        for name in ("dropout_p", "straggler_p", "corrupt_p"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -80,6 +118,27 @@ class FaultPlan:
             raise ValueError(
                 f"straggler_delay_s must be >= 0, got {self.straggler_delay_s}"
             )
+        if self.corrupt_k < 0:
+            raise ValueError(
+                f"corrupt_k must be >= 0, got {self.corrupt_k}"
+            )
+        if self.corrupt_mode not in CORRUPT_MODES:
+            raise ValueError(
+                f"corrupt_mode must be one of {sorted(CORRUPT_MODES)}, "
+                f"got {self.corrupt_mode!r}"
+            )
+        if not (
+            np.isfinite(self.corrupt_strength) and self.corrupt_strength >= 0
+        ):
+            raise ValueError(
+                f"corrupt_strength must be finite and >= 0, "
+                f"got {self.corrupt_strength}"
+            )
+
+    @property
+    def has_corruption(self) -> bool:
+        """Whether any round of this plan can corrupt an update."""
+        return self.corrupt_p > 0.0 or self.corrupt_k > 0
 
     # ------------------------------------------------------------- schedule
 
@@ -117,6 +176,45 @@ class FaultPlan:
         )
         return self.straggler_delay_s if rng.random() < self.straggler_p else 0.0
 
+    def corruption(
+        self, n_clients: int, nloop: int, gid: int, nadmm: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One round's corruption schedule: `(modes, strengths, seeds)`.
+
+        `modes [K]` int32 (0 = clean, else CORRUPT_MODES code),
+        `strengths [K]` float32, `seeds [K]` int32 (the per-client PRNG
+        seed the `gauss` mode folds into its on-device noise draw).
+        Pure in (seed, cursor) like the dropout masks — a separate seed
+        fold (+2), so adding corruption to a plan perturbs neither its
+        dropout masks nor its straggler schedule.
+        """
+        modes = np.zeros(n_clients, np.int32)
+        strengths = np.full(n_clients, self.corrupt_strength, np.float32)
+        seeds = np.zeros(n_clients, np.int32)
+        if not self.has_corruption:
+            return modes, strengths, seeds
+        rng = np.random.default_rng(
+            [(self.seed + 2) & 0x7FFFFFFF, nloop, gid, nadmm]
+        )
+        if self.corrupt_k > 0:
+            if self.corrupt_k > n_clients:
+                # same error the FaultInjector raises at construction —
+                # direct plan users must not get a silent every-client
+                # cap where the engine path gets a ValueError
+                raise ValueError(
+                    f"corrupt_k={self.corrupt_k} exceeds "
+                    f"n_clients={n_clients}: cannot corrupt more clients "
+                    "than exist per round"
+                )
+            chosen = rng.choice(n_clients, size=self.corrupt_k, replace=False)
+            hit = np.zeros(n_clients, bool)
+            hit[chosen] = True
+        else:
+            hit = rng.random(n_clients) < self.corrupt_p
+        modes[hit] = CORRUPT_MODES[self.corrupt_mode]
+        seeds[:] = rng.integers(0, 2**31 - 1, n_clients, dtype=np.int64)
+        return modes, strengths, seeds
+
     def crash_at(self, nloop: int, gid: int, nadmm: int) -> CrashPoint | None:
         for c in self.crashes:
             if (c.nloop, c.gid, c.nadmm) == (nloop, gid, nadmm):
@@ -132,9 +230,48 @@ class FaultPlan:
 
     @classmethod
     def from_json(cls, text: str) -> "FaultPlan":
+        """Parse a `to_json` document — STRICTLY.
+
+        Unknown keys are rejected by name instead of TypeError-ing (or,
+        worse, silently building a plan that ignores the typo'd field a
+        chaos experiment thought it configured); out-of-range values
+        surface as `__post_init__`'s per-field ValueErrors.
+        """
         d = json.loads(text)
-        crashes = tuple(CrashPoint(**c) for c in d.pop("crashes", []))
-        return cls(crashes=crashes, **d)
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"fault-plan JSON must be an object, got {type(d).__name__}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown fault-plan field(s) {unknown} in JSON plan; "
+                f"valid fields: {sorted(known)}"
+            )
+        crash_keys = {"nloop", "gid", "nadmm"}
+        crashes = []
+        crash_items = d.pop("crashes", [])
+        if not isinstance(crash_items, list):
+            raise ValueError(
+                f"crashes must be a list of crash-point objects, got "
+                f"{type(crash_items).__name__}"
+            )
+        for i, c in enumerate(crash_items):
+            if not isinstance(c, dict) or set(c) != crash_keys:
+                raise ValueError(
+                    f"crashes[{i}] must be an object with exactly the keys "
+                    f"{sorted(crash_keys)}, got {c!r}"
+                )
+            for k in sorted(crash_keys):
+                v = c[k]
+                # strict: int(1.9) would silently crash the wrong round
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise ValueError(
+                        f"crashes[{i}].{k} must be an int, got {v!r}"
+                    )
+            crashes.append(CrashPoint(**{k: c[k] for k in crash_keys}))
+        return cls(crashes=tuple(crashes), **d)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -143,10 +280,14 @@ class FaultPlan:
         Accepts (1) a path to a JSON file written by `to_json`, or (2) an
         inline spec of comma-separated `key=value` pairs:
 
-            seed=1,dropout=0.3,straggler=0.1:0.5,crash=0:1:2
+            seed=1,dropout=0.3,straggler=0.1:0.5,crash=0:1:2,corrupt=1:scale:10
 
-        where `straggler=p:delay_s` and each `crash=nloop:gid:nadmm` names
-        one crash point (repeatable).
+        where `straggler=p:delay_s`, each `crash=nloop:gid:nadmm` names
+        one crash point (repeatable), and `corrupt=<k-or-p>:<mode>[:strength]`
+        schedules update corruption: an INT first part corrupts exactly
+        that many clients per round (`corrupt_k`), a FLOAT is the
+        per-client probability (`corrupt_p`); mode is one of
+        scale|signflip|nan_burst|gauss.
         """
         if os.path.exists(spec):
             with open(spec) as f:
@@ -179,9 +320,24 @@ class FaultPlan:
                         f"crash point {val!r} must be nloop:gid:nadmm"
                     )
                 crashes.append(CrashPoint(*(int(p) for p in parts)))
+            elif key == "corrupt":
+                parts = val.split(":")
+                if not 2 <= len(parts) <= 3:
+                    raise ValueError(
+                        f"corrupt spec {val!r} must be "
+                        "<k-or-p>:<mode>[:strength]"
+                    )
+                amount = parts[0]
+                if "." in amount or "e" in amount.lower():
+                    kw["corrupt_p"] = float(amount)
+                else:
+                    kw["corrupt_k"] = int(amount)
+                kw["corrupt_mode"] = parts[1]
+                if len(parts) == 3:
+                    kw["corrupt_strength"] = float(parts[2])
             else:
                 raise ValueError(
                     f"unknown fault-plan key {key!r} "
-                    "(have seed, dropout, straggler, crash)"
+                    "(have seed, dropout, straggler, crash, corrupt)"
                 )
         return cls(crashes=tuple(crashes), **kw)

@@ -56,6 +56,10 @@ class CommLedger:
         self._uplink = 0
         self._full = 0
         self._rounds = 0
+        # uplink spent by QUARANTINED senders (consensus/robust.py
+        # auto-quarantine): they transmit — they don't know they're
+        # excluded — and the exchange discards the bytes on arrival
+        self._wasted = 0
 
     # --------------------------------------------------------- pure queries
 
@@ -93,17 +97,27 @@ class CommLedger:
         self._rounds += 1
         return b
 
-    def record(self, recorder, gid: int, survivors: int, *, nloop, nadmm) -> None:
-        """Account one consensus exchange and log its `comm_bytes` record."""
+    def record(
+        self, recorder, gid: int, survivors: int, *, nloop, nadmm,
+        quarantined: int = 0,
+    ) -> None:
+        """Account one consensus exchange and log its `comm_bytes` record.
+
+        `survivors` counts TRANSMITTING clients (plan-alive, whether
+        trusted or not); `quarantined` is how many of them the exchange
+        discarded on arrival — their share of the uplink is attributed
+        as wasted in the summary. The record grows a `quarantined` key
+        only when the count is nonzero, so quarantine-free streams are
+        byte-identical to pre-quarantine ones.
+        """
         b = self.account(gid, survivors)
-        recorder.log(
-            "comm_bytes",
-            int(b),
-            nloop=nloop,
-            group=gid,
-            nadmm=nadmm,
-            survivors=int(survivors),
+        self._wasted += self.round_bytes(gid, quarantined)
+        ctx = dict(
+            nloop=nloop, group=gid, nadmm=nadmm, survivors=int(survivors)
         )
+        if quarantined:
+            ctx["quarantined"] = int(quarantined)
+        recorder.log("comm_bytes", int(b), **ctx)
 
     def absorb(self, records: Sequence[dict]) -> None:
         """Seed the totals from replayed `comm_bytes` records.
@@ -117,6 +131,11 @@ class CommLedger:
             self._uplink += int(rec["value"])
             self._full += self.full_round_bytes(s)
             self._rounds += 1
+            q = int(rec.get("quarantined", 0))
+            if q and s:
+                # value == group_bytes * survivors exactly, so the
+                # per-sender share reconstructs without the partition
+                self._wasted += int(rec["value"]) // s * q
 
     def summary(self) -> dict:
         """End-of-run totals vs the two baselines (module docstring)."""
@@ -132,6 +151,9 @@ class CommLedger:
             ),
             "bytes_full_exchange": int(full),
             "savings_vs_full": round(full / up, 4) if up else None,
+            # uplink spent by quarantined senders — transmitted, then
+            # discarded at the exchange (the defense's bandwidth cost)
+            "bytes_quarantined_wasted": int(self._wasted),
             "data_floor_bytes": self.data_floor_bytes,
             "vs_data_floor": (
                 round(up / self.data_floor_bytes, 6)

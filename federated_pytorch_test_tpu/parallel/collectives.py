@@ -73,8 +73,12 @@ def weighted_client_mean(
 def all_clients(x_local: jnp.ndarray, axis_name: str = CLIENT_AXIS) -> jnp.ndarray:
     """Gather every client's value to all devices: `[K, ...]` everywhere.
 
-    Diagnostics only (the `distance_of_layers` equivalent, reference
-    src/federated_trio.py:170-186) — the training path never needs a full
-    gather, which is the bandwidth-saving contract.
+    Used by diagnostics (the `distance_of_layers` equivalent, reference
+    src/federated_trio.py:170-186) and by the Byzantine-robust order
+    statistics (consensus/robust.py): a coordinate-wise median/trim needs
+    every client's value per coordinate, so robust-agg exchanges
+    DELIBERATELY spend a full [K, N] gather on integrity. The mean path
+    keeps its psum — the reference's bandwidth-saving contract holds
+    exactly when `robust_agg='mean'` (the default).
     """
     return lax.all_gather(x_local, axis_name, axis=0, tiled=True)

@@ -344,6 +344,55 @@ class MetricsRecorder:
             ctx = " ".join(f"{k}={v}" for k, v in context.items())
             print(f"FAULT kind={kind} clients={ids} {ctx}")
 
+    def update_norms(self, norms, *, nloop, group, nadmm) -> None:
+        """Per-client update norms of one consensus exchange (`[K]`).
+
+        The auto-quarantine evidence series (consensus/robust.py
+        `update_suspects`): `‖x_k − z‖` for every alive client; a
+        non-finite norm (nan-burst-corrupted sender) records as `null` —
+        a bare NaN token would make the JSONL stream invalid RFC-8259
+        (jq and strict parsers abort mid-stream even though Python's
+        json.loads tolerates it). Only recorded when `quarantine_z` is
+        configured, so pre-quarantine runs keep their series byte-
+        identical. Deliberately NOT fed to the first-nonfinite cursor —
+        a corrupt update here is a DETECTED corruption, not a
+        training-health event.
+        """
+        vals = [
+            float(v) if math.isfinite(float(v)) else None for v in norms
+        ]
+        self.log("update_norm", vals, nloop=nloop, group=group, nadmm=nadmm)
+        if self.verbose:
+            print(
+                f"update_norm nloop={nloop} group={group} nadmm={nadmm} "
+                + ",".join("nonfinite" if v is None else f"{v:e}" for v in vals)
+            )
+
+    def quarantine(self, clients, *, nloop, group, nadmm) -> None:
+        """Clients auto-quarantined at one consensus exchange.
+
+        Flagged by their update-norm z-score (or a non-finite update) and
+        excluded from the REST OF THE ROUND's exchanges — the suspect
+        mask ANDs into the participation mask (docs/FAULT.md). Mirrors
+        `fault` (trace instant + grep-able line) but is its own series:
+        a quarantine is the DEFENSE acting, not a failure observed.
+        """
+        ids = [int(c) for c in clients]
+        self.log(
+            "quarantine", {"clients": ids}, nloop=nloop, group=group,
+            nadmm=nadmm,
+        )
+        if self.tracer is not None:
+            self.tracer.instant(
+                "fault:quarantine", clients=ids, nloop=nloop, group=group,
+                nadmm=nadmm,
+            )
+        if self.verbose:
+            print(
+                f"QUARANTINE clients={ids} nloop={nloop} group={group} "
+                f"nadmm={nadmm}"
+            )
+
     def group_distance(self, dists, *, nloop, group) -> None:
         """Per-group distance-from-mean diagnostic (`[num_groups]`).
 

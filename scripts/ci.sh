@@ -25,7 +25,11 @@
 #                                    sweeps, resnet-engine runs,
 #                                    streaming-equivalence, Pallas
 #                                    interpret kernels, ring, 2- and
-#                                    4-process distributed runs
+#                                    4-process distributed runs, plus the
+#                                    CLI chaos smoke below (corruption
+#                                    plan + trimmed combiner + quarantine
+#                                    + planned crash, recovered end to
+#                                    end with --resume auto)
 #
 # Usage:
 #   scripts/ci.sh            # tier 1 then tier 2 (both tiers, full CI)
@@ -38,14 +42,57 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+chaos_smoke() {
+  # End-to-end Byzantine chaos through the REAL CLI: one client per round
+  # sends a 10x-scaled update, trimmed-mean(1) + auto-quarantine defend,
+  # and a planned crash at (nloop=1, gid=2, nadmm=0) kills the first run
+  # mid-experiment (gid 2 is model net's first train_order group). The
+  # recovery procedure is rerunning the IDENTICAL command: --resume auto
+  # restores the checkpoint, the metric stream splices, and the run
+  # finishes with zero rollback rounds.
+  local d; d="$(mktemp -d)"
+  local cmd=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 240 --synthetic-n-test 60 --batch 40
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --fault-plan "seed=5,corrupt=1:scale:10,crash=1:2:0"
+    --robust-agg trimmed --robust-f 1 --quarantine-z 1.0
+    --fault-mode rollback --save-model --resume auto
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  echo "chaos smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "chaos smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "chaos smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "chaos smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  # 2 nloops x 1 group x 2 exchanges, one corrupted client each = 4
+  grep -q '# faults injected: .*corruptions=4' "$d/run2.log" || {
+    echo "chaos smoke FAILED: missing/incorrect injected-faults line" >&2
+    grep '# faults' "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  if grep -q 'round_rollback' "$d/run.jsonl"; then
+    echo "chaos smoke FAILED: the robust combiner let a round roll back" >&2
+    rm -rf "$d"; return 1
+  fi
+  echo "chaos smoke OK"
+  rm -rf "$d"
+}
+
 tier="${CI_TIER:-all}"
 case "$tier" in
   0) python -m pytest tests/ -m smoke -q "$@" ;;
   1) python -m pytest tests/ -m 'not slow' -q "$@" ;;
-  2) python -m pytest tests/ -m slow -q "$@" ;;
+  2)
+    python -m pytest tests/ -m slow -q "$@"
+    chaos_smoke
+    ;;
   all)
     python -m pytest tests/ -m 'not slow' -q "$@"
     python -m pytest tests/ -m slow -q "$@"
+    chaos_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;
 esac
