@@ -49,7 +49,7 @@ def main():
         for _ in range(reps + 1):  # first rep is compile/warmup
             t0 = time.perf_counter()
             if tr._stream:
-                lstate, _ = tr._run_stream_epoch(epoch_fn, lstate, y, z, rho)
+                lstate, _, _ = tr._run_stream_epoch(epoch_fn, lstate, y, z, rho)
                 # _run_stream_epoch fetches losses: already synchronized
             else:
                 idx = tr._epoch_indices(0, gid, 0, 0)[:STEPS]

@@ -32,6 +32,13 @@ makes the consensus exchange tolerate corrupted updates instead of
 averaging them in, and `--quarantine-z Z` auto-quarantines update-norm
 outliers for the rest of their round; the end-of-run summary gains a
 `# faults injected:` scoreboard and a quarantine-waste comm line.
+System heterogeneity: a plan's `slow=<k-or-p>[:factor]` axis models
+clients with slower compute, and `--round-deadline S` makes rounds
+deadline-based — each client runs the ragged inner-step budget it can
+afford (inside the same one-dispatch round program), deadline misses
+contribute partial updates instead of stalling the cohort, and
+tail-latency percentiles land in the `client_time` series
+(docs/FAULT.md §Heterogeneity).
 
 Observability (obs/, docs/OBSERVABILITY.md) rides it too:
 `--metrics-stream run.jsonl` streams every metric record to a crash-safe
@@ -109,7 +116,10 @@ def _print_summary(recorder, cfg) -> None:
         # (fault/injector.py injected_summary — a resumed run prints the
         # same totals); the quarantine count is a detection and survives
         # resume only via a replayed --metrics-stream
-        order = ("drops", "stragglers", "crashes", "corruptions", "quarantines")
+        order = (
+            "drops", "stragglers", "crashes", "corruptions",
+            "deadline_misses", "capped_stalls", "quarantines",
+        )
         print(
             "# faults injected: "
             + ", ".join(f"{k}={inj[k]}" for k in order if k in inj)

@@ -274,6 +274,22 @@ class ExperimentConfig:
     # 0 is the hair trigger.
     quarantine_z: float | None = None
 
+    # deadline-based rounds (docs/FAULT.md §Heterogeneity): the SIMULATED
+    # seconds each consensus round's local work may take. With a fault
+    # plan's compute-speed axis (`slow=<k-or-p>[:factor]`,
+    # `step_time=<s>`), every client gets the inner-step budget it can
+    # afford before the deadline — ragged local work via per-client step
+    # masks inside the round program (a masked step is an identity carry
+    # update) — and clients that miss the deadline contribute their
+    # PARTIAL update through the participation machinery instead of
+    # stalling the cohort (a zero-budget client has no report and is
+    # excluded like a dropped one). Host-side straggler stalls are
+    # capped at the deadline. None = lockstep rounds (the slowest client
+    # sets the round's simulated wall clock). Requires a consensus
+    # strategy; uniform budgets (a deadline no client misses) reproduce
+    # the lockstep trajectory bitwise (tests/test_hetero.py).
+    round_deadline: float | None = None
+
     # 'auto': restore the latest READABLE checkpoint under checkpoint_dir
     # if one exists, else start fresh — the crash-recovery switch a chaos
     # run restarts with (load_model instead *requires* a checkpoint).
@@ -364,6 +380,10 @@ class ExperimentConfig:
         if self.quarantine_z is not None and self.quarantine_z < 0:
             raise ValueError(
                 f"quarantine_z must be >= 0, got {self.quarantine_z}"
+            )
+        if self.round_deadline is not None and not self.round_deadline > 0:
+            raise ValueError(
+                f"round_deadline must be > 0, got {self.round_deadline}"
             )
 
     def lbfgs_config(self) -> LBFGSConfig:

@@ -156,6 +156,14 @@ class GroupContext(NamedTuple):
     # non-gauss plans compile the per-client PRNG draw out of the hot
     # program (a vmapped switch evaluates every branch)
     corrupt_gauss: bool = True
+    # ragged local work (deadline rounds, docs/FAULT.md §Heterogeneity):
+    # the epoch/round programs take per-client inner-step budgets and a
+    # masked step is an identity carry update — flat/lstate/stats keep
+    # their pre-step bits and the loss series repeats the client's last
+    # recorded loss. Static, so deadline-free runs compile the exact
+    # lockstep programs; a ragged program fed all-full budgets is
+    # bit-identical to them (every select picks the stepped operand).
+    ragged: bool = False
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
@@ -328,6 +336,60 @@ def _client_train_step(ctx: GroupContext):
     return step
 
 
+def _ragged_select(keep):
+    """Per-client select for one `[K_loc, ...]` carry leaf.
+
+    Where `keep[k]` holds the stepped value is adopted; elsewhere the
+    pre-step bits survive VERBATIM — the identity carry update of a
+    masked ragged step (GroupContext.ragged). With an all-true mask the
+    select returns the stepped operand bit for bit, which is what makes
+    a full-budget ragged program reproduce the lockstep trajectory
+    exactly (tests/test_hetero.py).
+    """
+
+    def sel(new, old):
+        return jnp.where(
+            keep.reshape(keep.shape + (1,) * (new.ndim - 1)), new, old
+        )
+
+    return sel
+
+
+def _ragged_scan(step_all, budgets, flat, lstate, stats, last_loss,
+                 data_xs, n_steps: int):
+    """Scan `n_steps` RAGGED training steps over one client block.
+
+    The one definition of the masked-step semantics, shared by
+    `build_epoch_fn`, `build_stream_epoch_fn`, and `build_round_fn` —
+    the ragged-fused==unfused bitwise contract (tests/test_hetero.py)
+    only holds while all three paths run the identical per-step selects.
+    Step t is an identity carry update for client k when
+    `t >= budgets[k]` (flat/lstate/stats keep their pre-step bits), and
+    the emitted loss row repeats the client's carried last loss.
+    `step_all(flat, lstate, stats, data_t)` runs one lockstep step on
+    the per-step slice of `data_xs`. Returns
+    `(flat, lstate, stats, losses [n_steps, K_loc], last_loss)`.
+    """
+
+    def body(carry, xs_t):
+        flat, lstate, stats, last_loss = carry
+        data_t, t = xs_t
+        flat2, lstate2, stats2, losses = step_all(flat, lstate, stats, data_t)
+        sel = _ragged_select(t < budgets)
+        flat = sel(flat2, flat)
+        lstate = jax.tree.map(sel, lstate2, lstate)
+        stats = jax.tree.map(sel, stats2, stats)
+        last_loss = sel(losses, last_loss)
+        return (flat, lstate, stats, last_loss), last_loss
+
+    (flat, lstate, stats, last_loss), losses = lax.scan(
+        body,
+        (flat, lstate, stats, last_loss),
+        (data_xs, jnp.arange(n_steps, dtype=jnp.int32)),
+    )
+    return flat, lstate, stats, losses, last_loss
+
+
 def _counted(fn, counter, category: str):
     """Wrap a built program in the dispatch-counting proxy (obs/trace.py).
 
@@ -345,15 +407,24 @@ def build_epoch_fn(ctx: GroupContext, mesh, counter=None):
     Signature:
       (flat [K,N], lstate, stats, shard_imgs [K,n,H,W,C] u8,
        shard_labels [K,n], idx [S,K,B], mean [K], std [K],
-       y [K,G], z [G], rho [K,1])
-      -> (flat, lstate, stats, losses [S,K])
+       y [K,G], z [G], rho [K,1]
+       [, budgets [K] i32, last_loss [K] — static `ctx.ragged` only])
+      -> (flat, lstate, stats, losses [S,K][, last_loss [K]])
 
     For non-ADMM strategies `y/z/rho` are zero-size placeholders (static
     python `None` is avoided so one signature serves all strategies).
+
+    With `ctx.ragged` the signature grows the per-client step `budgets`
+    of THIS dispatch (the trainer offsets the round budget by the steps
+    already served — epoch index, scan chunk, streamed chunk) and the
+    `last_loss` carry threaded across the round's dispatches: step t is
+    an identity carry update for client k when `t >= budgets[k]`, and
+    its loss row repeats `last_loss[k]` (docs/FAULT.md §Heterogeneity).
     """
     client_step = _client_train_step(ctx)
 
-    def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std, y, z, rho):
+    def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
+              y, z, rho, *rest):
         # the replicated consensus vector is closed over by the L-BFGS
         # while_loop inside client_step; promote it to varying up front —
         # JAX's vma fixpoint re-applies recorded pvary insertions when
@@ -361,16 +432,26 @@ def build_epoch_fn(ctx: GroupContext, mesh, counter=None):
         # closed-over constant (see parallel.mark_varying)
         z = mark_varying(z, CLIENT_AXIS)
 
-        def body(carry, idx_t):
-            flat, lstate, stats = carry
+        def step_all(flat, lstate, stats, idx_t):
             images = jnp.take_along_axis(
                 shard_imgs, idx_t[:, :, None, None, None], axis=1
             )
             labels = jnp.take_along_axis(shard_labels, idx_t, axis=1)
-            flat, lstate, stats, losses = jax.vmap(
+            return jax.vmap(
                 client_step,
                 in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, 0),
             )(flat, lstate, stats, images, labels, mean, std, y, z, rho)
+
+        if ctx.ragged:
+            budgets, last_loss = rest
+            return _ragged_scan(
+                step_all, budgets, flat, lstate, stats, last_loss,
+                idx, idx.shape[0],
+            )
+
+        def body(carry, idx_t):
+            flat, lstate, stats = carry
+            flat, lstate, stats, losses = step_all(flat, lstate, stats, idx_t)
             return (flat, lstate, stats), losses
 
         (flat, lstate, stats), losses = lax.scan(
@@ -380,11 +461,16 @@ def build_epoch_fn(ctx: GroupContext, mesh, counter=None):
 
     c = P(CLIENT_AXIS)
     r = P()
+    in_specs = (c, c, c, c, c, P(None, CLIENT_AXIS), c, c, c, r, c)
+    out_specs = (c, c, c, P(None, CLIENT_AXIS))
+    if ctx.ragged:
+        in_specs = in_specs + (c, c)  # budgets, last_loss
+        out_specs = out_specs + (c,)  # last_loss carry out
     sharded = shard_map(
         local,
         mesh=mesh,
-        in_specs=(c, c, c, c, c, P(None, CLIENT_AXIS), c, c, c, r, c),
-        out_specs=(c, c, c, P(None, CLIENT_AXIS)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=_check_vma(ctx),
     )
     # params/opt-state/batch-stats are consumed and re-emitted every epoch:
@@ -407,21 +493,37 @@ def build_stream_epoch_fn(ctx: GroupContext, mesh, counter=None):
 
     Signature:
       (flat [K,N], lstate, stats, images [S,K,B,H,W,C] u8,
-       labels [S,K,B], mean [K], std [K], y [K,G], z [G], rho [K,1])
-      -> (flat, lstate, stats, losses [S,K])
+       labels [S,K,B], mean [K], std [K], y [K,G], z [G], rho [K,1]
+       [, budgets [K] i32, last_loss [K] — static `ctx.ragged` only])
+      -> (flat, lstate, stats, losses [S,K][, last_loss [K]])
+
+    Ragged budgets are per CHUNK, like `build_epoch_fn`'s per-dispatch
+    contract: the trainer offsets the round budget by the lockstep steps
+    already streamed.
     """
     client_step = _client_train_step(ctx)
 
-    def local(flat, lstate, stats, images_u8, labels, mean, std, y, z, rho):
+    def local(flat, lstate, stats, images_u8, labels, mean, std, y, z, rho,
+              *rest):
         z = mark_varying(z, CLIENT_AXIS)  # see build_epoch_fn
 
-        def body(carry, batch):
-            flat, lstate, stats = carry
+        def step_all(flat, lstate, stats, batch):
             imgs_t, labels_t = batch  # [K,B,H,W,C], [K,B]
-            flat, lstate, stats, losses = jax.vmap(
+            return jax.vmap(
                 client_step,
                 in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, 0),
             )(flat, lstate, stats, imgs_t, labels_t, mean, std, y, z, rho)
+
+        if ctx.ragged:
+            budgets, last_loss = rest
+            return _ragged_scan(
+                step_all, budgets, flat, lstate, stats, last_loss,
+                (images_u8, labels), labels.shape[0],
+            )
+
+        def body(carry, batch):
+            flat, lstate, stats = carry
+            flat, lstate, stats, losses = step_all(flat, lstate, stats, batch)
             return (flat, lstate, stats), losses
 
         (flat, lstate, stats), losses = lax.scan(
@@ -432,11 +534,16 @@ def build_stream_epoch_fn(ctx: GroupContext, mesh, counter=None):
     c = P(CLIENT_AXIS)
     r = P()
     sc = P(None, CLIENT_AXIS)  # [S, K, ...] chunks: K is the mesh axis
+    in_specs = (c, c, c, sc, sc, c, c, c, r, c)
+    out_specs = (c, c, c, sc)
+    if ctx.ragged:
+        in_specs = in_specs + (c, c)
+        out_specs = out_specs + (c,)
     sharded = shard_map(
         local,
         mesh=mesh,
-        in_specs=(c, c, c, sc, sc, c, c, c, r, c),
-        out_specs=(c, c, c, sc),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=_check_vma(ctx),
     )
     # donate params/opt-state/stats as in build_epoch_fn; the image chunk
@@ -675,6 +782,7 @@ def build_round_fn(
        shard_labels [K,n], idx [nadmm, nepoch, S, K, B],
        mean [K], std [K], y [K,G], z [G], rho [K,1], extra,
        masks [nadmm, K]
+       [, budgets [nadmm, K] i32 — static `ctx.ragged` only]
        [, cmodes [nadmm, K] i32, cstrengths [nadmm, K], cseeds
           [nadmm, K] i32 — static `ctx.corrupt` only]
        [, test_imgs [T,B,...], test_labels [T,B], test_mask [T,B]
@@ -691,6 +799,17 @@ def build_round_fn(
     * `masks [nadmm, K]` are the per-consensus-round participation masks
       (fault/injector.py `masks_for_round`), scan xs; all-ones without a
       fault plan — bit-identical to the maskless math.
+    * `budgets [nadmm, K]` (static `ctx.ragged` only) are the per-client
+      inner-step budgets of each consensus iteration
+      (fault/injector.py `step_budgets_for_round`), scan xs: step t of
+      an iteration is an identity carry update for client k when
+      `t >= budgets[k]` — flat/lstate/stats keep their pre-step bits and
+      the loss row repeats the client's last recorded loss of the round
+      (zero until its first active step). A ZERO-budget client produced
+      no report by the deadline, so it is ANDed out of that iteration's
+      effective participation mask exactly like a dropped client — the
+      all-zero-budget exchange keeps z, and all-FULL budgets are
+      bit-identical to the lockstep program (tests/test_hetero.py).
     * `cmodes`/`cstrengths`/`cseeds` (static `ctx.corrupt` only) are the
       round's corruption schedule (fault/injector.py
       `corruption_for_round`), scan xs: each consensus iteration's
@@ -747,13 +866,16 @@ def build_round_fn(
     quarantine = (
         ctx.quarantine_z is not None and consensus_local is not None
     )
+    ragged = ctx.ragged
 
     def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
               y, z, rho, extra, masks, *rest):
-        # *rest, by static flags: [cmodes, cstrengths, cseeds] when the
-        # plan schedules corruption, then [test_imgs, test_labels,
-        # test_mask] when the eval is folded
+        # *rest, by static flags: [budgets] when the round is ragged,
+        # then [cmodes, cstrengths, cseeds] when the plan schedules
+        # corruption, then [test_imgs, test_labels, test_mask] when the
+        # eval is folded
         rest = list(rest)
+        budget_rows = rest.pop(0) if ragged else ()
         corr_rows = tuple(rest[:3]) if corrupt else ()
         if corrupt:
             rest = rest[3:]
@@ -762,44 +884,67 @@ def build_round_fn(
         )
 
         def round_body(carry, xs):
-            flat, lstate, stats, y, z, rho, extra, qmask = carry
+            flat, lstate, stats, y, z, rho, extra, qmask, lloss = carry
             # [nepoch, S, K_loc, B], [K_loc], i32, per-iteration [K_loc]
-            # corruption rows
-            idx_a, mask_a, na, corr_a = xs
+            # budget and corruption rows
+            idx_a, mask_a, na, budget_a, corr_a = xs
             # replicated consensus vector -> varying for the closed-over
             # L-BFGS while_loop (see build_epoch_fn); the CARRY keeps the
             # unvarying z so its type is stable across scan iterations
             # (the consensus psum emits an unvarying znew)
             zv = mark_varying(z, CLIENT_AXIS)
 
-            def batch_body(c, idx_t):
-                flat, lstate, stats = c
+            def step_all(flat, lstate, stats, idx_t):
                 images = jnp.take_along_axis(
                     shard_imgs, idx_t[:, :, None, None, None], axis=1
                 )
                 labels = jnp.take_along_axis(shard_labels, idx_t, axis=1)
-                flat, lstate, stats, losses = jax.vmap(
+                return jax.vmap(
                     client_step,
                     in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, 0),
                 )(flat, lstate, stats, images, labels, mean, std, y, zv, rho)
-                return (flat, lstate, stats), losses
 
             # the epoch boundary is invisible to the minibatch body (a
             # fresh shuffle is just the next idx rows), so nepoch epochs
             # flatten into one [nepoch*S] scan — iteration-for-iteration
             # the sequence the unfused path runs as nepoch programs
             s = idx_a.shape[1]
-            (flat, lstate, stats), losses = lax.scan(
-                batch_body,
-                (flat, lstate, stats),
-                idx_a.reshape((nepoch * s,) + idx_a.shape[2:]),
-            )
+            idx_flat = idx_a.reshape((nepoch * s,) + idx_a.shape[2:])
+            if ragged:
+                # per-client step masks (_ragged_scan — the shared
+                # masked-step semantics): the lloss carry crosses
+                # consensus iterations, so a zero-budget iteration shows
+                # the client's last loss from an EARLIER iteration
+                flat, lstate, stats, losses, lloss = _ragged_scan(
+                    step_all, budget_a, flat, lstate, stats, lloss,
+                    idx_flat, nepoch * s,
+                )
+            else:
+
+                def batch_body(c, idx_t):
+                    flat, lstate, stats = c
+                    flat, lstate, stats, losses = step_all(
+                        flat, lstate, stats, idx_t
+                    )
+                    return (flat, lstate, stats), losses
+
+                (flat, lstate, stats), losses = lax.scan(
+                    batch_body, (flat, lstate, stats), idx_flat
+                )
             losses = losses.reshape((nepoch, s) + losses.shape[1:])
 
             if consensus_local is not None:
                 # quarantine ANDs into the plan mask: a client flagged at
-                # an earlier exchange of THIS round is excluded here
-                eff_mask = mask_a * qmask if quarantine else mask_a
+                # an earlier exchange of THIS round is excluded here. A
+                # zero-budget client never produced a report by the
+                # deadline, so it drops out of the exchange the same way.
+                eff_mask = mask_a
+                if ragged:
+                    eff_mask = eff_mask * (budget_a > 0).astype(
+                        eff_mask.dtype
+                    )
+                if quarantine:
+                    eff_mask = eff_mask * qmask
                 flat, y, z, rho, extra, met, qstats = consensus_local(
                     flat, y, z, rho, extra, na, eff_mask, *corr_a
                 )
@@ -824,19 +969,24 @@ def build_round_fn(
                     client_eval, in_axes=(0, 0, None, None, None, 0, 0)
                 )(flat, stats, test_imgs, test_labels, test_mask, mean, std)
                 ys = ys + (correct,)
-            return (flat, lstate, stats, y, z, rho, extra, qmask), ys
+            return (flat, lstate, stats, y, z, rho, extra, qmask, lloss), ys
 
         # the quarantine carry starts all-clear; derived from the varying
         # masks input so its vma type matches the suspect-driven updates
         qmask0 = jnp.ones_like(masks[0]) if quarantine else ()
-        carry = (flat, lstate, stats, y, z, rho, extra, qmask0)
+        # the ragged last-loss carry starts at zero (a client reports 0.0
+        # until its first active step of the round); vma_zero keeps the
+        # varying type the per-client selects produce
+        lloss0 = vma_zero(mean) if ragged else ()
+        carry = (flat, lstate, stats, y, z, rho, extra, qmask0, lloss0)
         na_seq = jnp.arange(nadmm, dtype=jnp.int32)
-        # corr_rows is () without corruption — a leafless xs entry whose
-        # per-step slice stays (), so one scan call serves both builds
+        # corr_rows (and budget_rows) are () when their static flag is
+        # off — a leafless xs entry whose per-step slice stays (), so one
+        # scan call serves every build
         carry, ys = lax.scan(
-            round_body, carry, (idx, masks, na_seq, corr_rows)
+            round_body, carry, (idx, masks, na_seq, budget_rows, corr_rows)
         )
-        flat, lstate, stats, y, z, rho, extra, _ = carry
+        flat, lstate, stats, y, z, rho, extra, _, _ = carry
         losses, met, param_ok = ys[:3]
         i = 3
         qstats = (ys[i][0], ys[i][1]) if quarantine else ()
@@ -855,6 +1005,8 @@ def build_round_fn(
         c, c, c, r, c, (c, c),
         sc1,  # masks [nadmm, K]
     )
+    if ragged:
+        in_specs = in_specs + (sc1,)  # step budgets [nadmm, K]
     if corrupt:
         in_specs = in_specs + (sc1, sc1, sc1)  # corruption mode/str/seed
     if fold_eval:

@@ -42,7 +42,11 @@ from federated_pytorch_test_tpu.engine.steps import (
     build_round_init_fn,
     build_stream_epoch_fn,
 )
-from federated_pytorch_test_tpu.fault import FaultInjector, FaultPlan
+from federated_pytorch_test_tpu.fault import (
+    FaultInjector,
+    FaultPlan,
+    step_budgets,
+)
 from federated_pytorch_test_tpu.models import MODELS
 from federated_pytorch_test_tpu.obs import (
     CommLedger,
@@ -380,13 +384,22 @@ class Trainer:
             # stream to keep it.)
             for nloop in range(self._completed_nloops):
                 for gid in self.group_order:
+                    budgets = (
+                        self._round_hetero(nloop, gid)[1]
+                        if self._ragged_enabled()
+                        else None
+                    )
                     for a in range(cfg.nadmm):
-                        surv = (
-                            int(self.injector.mask(nloop, gid, a).sum())
+                        m = (
+                            self.injector.mask(nloop, gid, a)
                             if self.injector is not None
-                            else cfg.n_clients
+                            else np.ones(cfg.n_clients, np.float32)
                         )
-                        self._comm.account(gid, surv)
+                        if budgets is not None:
+                            # zero-budget clients never transmitted
+                            # (deadline rounds) — same pure-plan recompute
+                            m = m * (budgets[a] > 0)
+                        self._comm.account(gid, int(m.sum()))
         if cfg.average_model:
             # one-shot whole-model average before training
             # (reference src/no_consensus_trio.py:22,134-160)
@@ -529,6 +542,7 @@ class Trainer:
                 self._corruption_enabled()
                 and self.injector.plan.corrupt_mode == "gauss"
             ),
+            ragged=self._ragged_enabled(),
         )
 
     def _quarantine_enabled(self) -> bool:
@@ -550,6 +564,96 @@ class Trainer:
             and self.injector.has_corruption
             and self.cfg.strategy != "none"
         )
+
+    def _ragged_enabled(self) -> bool:
+        """Whether rounds are deadline-based with ragged local work.
+
+        Like `_corruption_enabled`, ONE definition fixes both the
+        compiled programs' argument signature (GroupContext.ragged) and
+        whether every call site passes the budget rows. Deadlines are a
+        cohort concept — a client misses the deadline OF an exchange —
+        so strategy-'none' runs (no exchange) stay lockstep.
+        """
+        return (
+            self.cfg.round_deadline is not None
+            and self.cfg.strategy != "none"
+        )
+
+    def _hetero_enabled(self) -> bool:
+        """Whether the tail-latency telemetry records (client_time /
+        step_budget / deadline_miss): any run with a deadline OR a plan
+        scheduling slow clients. Homogeneous deadline-free runs record
+        nothing, keeping their metric streams byte-identical to
+        pre-heterogeneity ones."""
+        return self.cfg.strategy != "none" and (
+            self.cfg.round_deadline is not None
+            or (self.injector is not None and self.injector.has_heterogeneity)
+        )
+
+    def _round_total_steps(self) -> int:
+        """Lockstep inner steps of ONE consensus iteration's local work
+        (the quantity a step budget is clipped against)."""
+        return self.cfg.nepoch * self.fed.steps_per_epoch(self.cfg.batch)
+
+    def _round_hetero(self, nloop: int, gid: int):
+        """One round's heterogeneity schedule, all host-side numpy.
+
+        Returns `(speeds [nadmm, K], budgets [nadmm, K] i32 or None,
+        times [nadmm, K])`: per-step time multipliers from the plan's
+        speed axis (all-ones without one), the deadline step budgets
+        (None without a deadline), and each client's SIMULATED seconds
+        to complete its full local work — the tail-latency evidence
+        (`client_time` percentiles). Pure in (plan seed, cursor, config),
+        so resumed runs re-derive identical records.
+        """
+        cfg = self.cfg
+        total = self._round_total_steps()
+        if self.injector is not None:
+            speeds = self.injector.speeds_for_round(nloop, gid, cfg.nadmm)
+            step_t = self.injector.plan.step_time_s
+        else:
+            speeds = np.ones((cfg.nadmm, cfg.n_clients), np.float32)
+            step_t = 1.0
+        times = total * step_t * speeds
+        budgets = None
+        if cfg.round_deadline is not None:
+            # the ONE deadline->budget conversion (fault/injector.py
+            # step_budgets) — shared with the scoreboard so the program's
+            # budgets and the deadline_misses rows cannot drift apart
+            budgets = step_budgets(
+                speeds, step_t, total, cfg.round_deadline
+            )
+        return speeds, budgets, times
+
+    def _record_hetero(
+        self, times_a: np.ndarray, budgets_a, *, nloop, gid, a, total
+    ) -> None:
+        """Record one exchange's tail-latency observability: simulated
+        client-time percentiles (+ the round's simulated wall — capped
+        at the deadline, since the coordinator closes the round there),
+        the per-client step budgets, and a `deadline_miss` record when
+        any client's budget fell short of the lockstep step count."""
+        deadline = self.cfg.round_deadline
+        round_time = float(times_a.max())
+        if deadline is not None:
+            round_time = min(round_time, float(deadline))
+        pct = {
+            "p50": float(np.percentile(times_a, 50)),
+            "p95": float(np.percentile(times_a, 95)),
+            "p99": float(np.percentile(times_a, 99)),
+            "max": float(times_a.max()),
+            "round": round_time,
+        }
+        self.recorder.client_times(pct, nloop=nloop, group=gid, nadmm=a)
+        if budgets_a is not None:
+            self.recorder.step_budgets(
+                budgets_a, nloop=nloop, group=gid, nadmm=a
+            )
+            missed = np.where(budgets_a < total)[0]
+            if missed.size:
+                self.recorder.deadline_miss(
+                    missed, nloop=nloop, group=gid, nadmm=a
+                )
 
     def _fns(self, gid: int):
         if gid not in self._epoch_fns:
@@ -854,7 +958,23 @@ class Trainer:
             )
         return local
 
-    def _run_stream_epoch(self, epoch_fn, lstate, y, z, rho):
+    def _ragged_args(self, budgets_np, offset: int, n_steps: int, last_loss):
+        """Per-dispatch ragged arguments `(budgets [K], last_loss [K])`.
+
+        The compiled epoch program masks steps against a budget LOCAL to
+        its dispatch, so the round budget is offset by the lockstep
+        steps already served (`offset`) and clipped to this dispatch's
+        step count — the monotone prefix property (a client's active
+        steps are the first `budget` of the round) makes the offset
+        slicing exact.
+        """
+        csh = client_sharding(self.mesh)
+        b = np.clip(budgets_np - offset, 0, n_steps).astype(np.int32)
+        return self._put(b, csh), last_loss
+
+    def _run_stream_epoch(
+        self, epoch_fn, lstate, y, z, rho, budgets_np=None, last_loss=None
+    ):
         """One epoch through the host-streaming path, double-buffered.
 
         Chunks of `stream_chunk_steps` lockstep minibatches are assembled
@@ -862,7 +982,9 @@ class Trainer:
         while the PREVIOUS chunk's jitted scan is still executing
         (dispatch is asynchronous), and consumed in order — H2D transfer
         overlaps compute, and only ~2 chunks of data are ever resident.
-        Returns `(lstate, losses [S_total, K])`.
+        `budgets_np` (ragged rounds) carries this EPOCH's per-client step
+        budgets; each chunk gets the offset slice. Returns
+        `(lstate, losses [S_total, K], last_loss)`.
         """
         cfg = self.cfg
         k = cfg.n_clients
@@ -889,6 +1011,7 @@ class Trainer:
             return self._put(imgs, sh), self._put(labs, sh)
 
         remaining = s_total
+        done = 0
         nxt = assemble(min(chunk, remaining))
         flat, stats = self.flat, self.stats
         losses = []
@@ -896,10 +1019,18 @@ class Trainer:
             n = min(chunk, remaining)
             remaining -= n
             cur_imgs, cur_labs = nxt
-            flat, lstate, stats, l = epoch_fn(
-                flat, lstate, stats, cur_imgs, cur_labs,
-                self.mean, self.std, y, z, rho,
-            )  # asynchronous dispatch: host continues immediately
+            if budgets_np is not None:
+                b, ll = self._ragged_args(budgets_np, done, n, last_loss)
+                flat, lstate, stats, l, last_loss = epoch_fn(
+                    flat, lstate, stats, cur_imgs, cur_labs,
+                    self.mean, self.std, y, z, rho, b, ll,
+                )
+            else:
+                flat, lstate, stats, l = epoch_fn(
+                    flat, lstate, stats, cur_imgs, cur_labs,
+                    self.mean, self.std, y, z, rho,
+                )  # asynchronous dispatch: host continues immediately
+            done += n
             if remaining > 0:
                 # assemble + stage the NEXT chunk while the device runs
                 nxt = assemble(min(chunk, remaining))
@@ -907,9 +1038,12 @@ class Trainer:
         self.flat, self.stats = flat, stats
         return lstate, np.concatenate(
             [self._fetch(l) for l in losses], axis=0
-        )
+        ), last_loss
 
-    def _run_resident_epoch(self, epoch_fn, lstate, y, z, rho, idx):
+    def _run_resident_epoch(
+        self, epoch_fn, lstate, y, z, rho, idx, budgets_np=None,
+        last_loss=None,
+    ):
         """One resident epoch, auto-chunked to `cfg.max_scan_steps`.
 
         A single jitted program scanning many hundred training steps can
@@ -919,27 +1053,49 @@ class Trainer:
         longer than the cap run as sequential calls over `idx` slices.
         The trajectory is bit-identical: the scan is sequential either
         way, and `flat/lstate/stats` carry across calls exactly as they
-        carry across scan iterations. Returns `(lstate, losses [S, K])`.
+        carry across scan iterations. `budgets_np` (ragged rounds) is
+        this epoch's per-client step budgets; chunked calls get offset
+        slices. Returns `(lstate, losses [S, K], last_loss)`.
         """
         cap = self.cfg.max_scan_steps
         s_total = idx.shape[0]
         if cap is None or s_total <= cap:
-            self.flat, lstate, self.stats, losses = epoch_fn(
-                self.flat, lstate, self.stats, self.shard_imgs,
-                self.shard_labels, idx, self.mean, self.std, y, z, rho,
-            )
-            return lstate, self._fetch(losses)
+            if budgets_np is not None:
+                b, ll = self._ragged_args(budgets_np, 0, s_total, last_loss)
+                (self.flat, lstate, self.stats, losses,
+                 last_loss) = epoch_fn(
+                    self.flat, lstate, self.stats, self.shard_imgs,
+                    self.shard_labels, idx, self.mean, self.std, y, z, rho,
+                    b, ll,
+                )
+            else:
+                self.flat, lstate, self.stats, losses = epoch_fn(
+                    self.flat, lstate, self.stats, self.shard_imgs,
+                    self.shard_labels, idx, self.mean, self.std, y, z, rho,
+                )
+            return lstate, self._fetch(losses), last_loss
         losses = []
         for lo in range(0, s_total, cap):
-            self.flat, lstate, self.stats, l = epoch_fn(
-                self.flat, lstate, self.stats, self.shard_imgs,
-                self.shard_labels, idx[lo : lo + cap], self.mean,
-                self.std, y, z, rho,
-            )  # asynchronous dispatch: slices queue back-to-back
+            sl = idx[lo : lo + cap]
+            if budgets_np is not None:
+                b, ll = self._ragged_args(
+                    budgets_np, lo, int(sl.shape[0]), last_loss
+                )
+                self.flat, lstate, self.stats, l, last_loss = epoch_fn(
+                    self.flat, lstate, self.stats, self.shard_imgs,
+                    self.shard_labels, sl, self.mean, self.std, y, z, rho,
+                    b, ll,
+                )
+            else:
+                self.flat, lstate, self.stats, l = epoch_fn(
+                    self.flat, lstate, self.stats, self.shard_imgs,
+                    self.shard_labels, sl, self.mean,
+                    self.std, y, z, rho,
+                )  # asynchronous dispatch: slices queue back-to-back
             losses.append(l)
         return lstate, np.concatenate(
             [self._fetch(l) for l in losses], axis=0
-        )
+        ), last_loss
 
     def compile_round(self, gid: int) -> float:
         """AOT-compile one group's jitted programs WITHOUT executing the
@@ -975,6 +1131,18 @@ class Trainer:
                     np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
                     sh,
                 )
+                budget_args = ()
+                if self._ragged_enabled():
+                    budget_args = (
+                        self._put(
+                            np.full(
+                                (self.cfg.nadmm, self.cfg.n_clients),
+                                self._round_total_steps(),
+                                np.int32,
+                            ),
+                            sh,
+                        ),
+                    )
                 corr_args = ()
                 if ctx_corrupt:
                     shape = (self.cfg.nadmm, self.cfg.n_clients)
@@ -991,7 +1159,8 @@ class Trainer:
                 round_fn.lower(
                     self.flat, lstate, self.stats, self.shard_imgs,
                     self.shard_labels, idx, self.mean, self.std,
-                    y, z, rho, extra, masks, *corr_args, *eval_args,
+                    y, z, rho, extra, masks, *budget_args, *corr_args,
+                    *eval_args,
                 ).compile()
                 return time.perf_counter() - t0
             epoch_fn, consensus_fn, init_fn = self._fns(gid)
@@ -1007,9 +1176,20 @@ class Trainer:
                 if idx.shape[0] % cap:
                     slices.append(idx[: idx.shape[0] % cap])
             for sl in slices:
+                ragged_args = ()
+                if self._ragged_enabled():
+                    csh = client_sharding(self.mesh)
+                    k = self.cfg.n_clients
+                    ragged_args = (
+                        self._put(
+                            np.full(k, int(sl.shape[0]), np.int32), csh
+                        ),
+                        self._put(np.zeros(k, np.float32), csh),
+                    )
                 epoch_fn.lower(
                     self.flat, lstate, self.stats, self.shard_imgs,
                     self.shard_labels, sl, self.mean, self.std, y, z, rho,
+                    *ragged_args,
                 ).compile()
             if consensus_fn is not None:
                 corr_args = ()
@@ -1170,13 +1350,37 @@ class Trainer:
         gsize = self.partition.group_size(gid)
         corrupt = self._corruption_enabled()
         quarantine = self._quarantine_enabled()
+        ragged = self._ragged_enabled()
+        hetero = self._hetero_enabled()
+        total_steps = self._round_total_steps()
+        s_epoch = self.fed.steps_per_epoch(cfg.batch)
+        budgets_m = times_m = None
+        if hetero:
+            _, budgets_m, times_m = self._round_hetero(nloop, gid)
+        # the ragged last-loss carry, threaded ACROSS the round's epoch
+        # dispatches (the fused path carries it in-scan): a masked step's
+        # loss row repeats the client's last recorded loss of the round
+        last_loss = (
+            self._put(
+                np.zeros(cfg.n_clients, np.float32),
+                client_sharding(self.mesh),
+            )
+            if ragged
+            else None
+        )
         # the round-scoped quarantine mask (1 = trusted): suspects flagged
         # at one exchange are excluded from the round's later exchanges —
         # the host-side twin of the fused round's in-carry qmask
         qmask_np = np.ones(cfg.n_clients, np.float32)
 
         for nadmm in range(cfg.nadmm):
+            budgets_a = budgets_m[nadmm] if budgets_m is not None else None
             for epoch in range(cfg.nepoch):
+                # this epoch's slice of the consensus iteration's budget
+                # (steps already served by earlier epochs offset it)
+                budget_e = (
+                    budgets_a - epoch * s_epoch if ragged else None
+                )
                 # streaming shuffles inside the PrefetchBatcher instead
                 idx = (
                     None
@@ -1191,8 +1395,8 @@ class Trainer:
                     "epoch", step_num=self._step_num
                 ):
                     if self._stream:
-                        lstate, losses = self._run_stream_epoch(
-                            epoch_fn, lstate, y, z, rho
+                        lstate, losses, last_loss = self._run_stream_epoch(
+                            epoch_fn, lstate, y, z, rho, budget_e, last_loss
                         )
                     elif per_batch_eval:
                         # reference check_results=True telemetry: evaluate
@@ -1202,7 +1406,12 @@ class Trainer:
                         # jitted eval sweep interleaves
                         rows = []
                         for s in range(idx.shape[0]):
-                            (self.flat, lstate, self.stats, l_s) = epoch_fn(
+                            ragged_args = ()
+                            if ragged:
+                                ragged_args = self._ragged_args(
+                                    budget_e, s, 1, last_loss
+                                )
+                            outs = epoch_fn(
                                 self.flat,
                                 lstate,
                                 self.stats,
@@ -1214,7 +1423,13 @@ class Trainer:
                                 y,
                                 z,
                                 rho,
+                                *ragged_args,
                             )
+                            if ragged:
+                                (self.flat, lstate, self.stats, l_s,
+                                 last_loss) = outs
+                            else:
+                                self.flat, lstate, self.stats, l_s = outs
                             rows.append(self._fetch(l_s)[0])
                             self.recorder.accuracies(
                                 self.evaluate_deferred(),
@@ -1226,8 +1441,9 @@ class Trainer:
                             )
                         losses = np.stack(rows)  # [S, K]
                     else:
-                        lstate, losses = self._run_resident_epoch(
-                            epoch_fn, lstate, y, z, rho, idx
+                        lstate, losses, last_loss = self._run_resident_epoch(
+                            epoch_fn, lstate, y, z, rho, idx, budget_e,
+                            last_loss,
                         )  # [S, K]
                 for s in range(losses.shape[0]):
                     self.recorder.batch_losses(
@@ -1262,6 +1478,11 @@ class Trainer:
                     m_np = self.injector.mask(nloop, gid, nadmm)
                     delay = self.injector.straggler_delay(nloop, gid, nadmm)
                     if delay > 0:
+                        if cfg.round_deadline is not None:
+                            # deadline rounds cap the coordinator's wait:
+                            # past the deadline the round closes without
+                            # the straggler instead of stalling for it
+                            delay = min(delay, cfg.round_deadline)
                         # the coordinator waiting out a slow client before
                         # declaring the round: a host-side stall, recorded
                         # so chaos runs show up in the timing series
@@ -1273,12 +1494,27 @@ class Trainer:
                             nadmm=nadmm,
                         )
                         time.sleep(delay)
+                if hetero:
+                    self._record_hetero(
+                        times_m[nadmm], budgets_a,
+                        nloop=nloop, gid=gid, a=nadmm, total=total_steps,
+                    )
+                # a zero-budget client produced no report by the deadline:
+                # it transmits nothing and drops out of the exchange like
+                # a plan-dropped client
+                transmit_np = (
+                    m_np * (budgets_a > 0) if ragged else m_np
+                ).astype(np.float32)
                 # quarantined clients still transmit (they don't know);
                 # the exchange just discards their contribution
                 quarantined_now = (
-                    int((m_np * (1.0 - qmask_np)).sum()) if quarantine else 0
+                    int((transmit_np * (1.0 - qmask_np)).sum())
+                    if quarantine
+                    else 0
                 )
-                eff_np = m_np * qmask_np if quarantine else m_np
+                eff_np = (
+                    transmit_np * qmask_np if quarantine else transmit_np
+                )
                 mask = (
                     self._full_mask
                     if eff_np.sum() >= self.cfg.n_clients
@@ -1330,7 +1566,7 @@ class Trainer:
                 # client — plan survivors; a quarantined client's bytes
                 # still cross the wire and are attributed as wasted
                 self._comm.record(
-                    self.recorder, gid, int(m_np.sum()),
+                    self.recorder, gid, int(transmit_np.sum()),
                     nloop=nloop, nadmm=nadmm, quarantined=quarantined_now,
                 )
                 if quarantine:
@@ -1408,17 +1644,22 @@ class Trainer:
 
         idx = self._round_indices(nloop, gid)
         masks_np = np.ones((cfg.nadmm, cfg.n_clients), np.float32)
+        total_delay = 0.0
         # masks and straggler stalls belong to the CONSENSUS exchange —
         # the unfused path draws them under `if consensus_fn is not None`,
         # so independent (strategy 'none') chaos runs must not stall or
         # record them here either
         if self.injector is not None and cfg.strategy != "none":
             masks_np = self.injector.masks_for_round(nloop, gid, cfg.nadmm)
-            total_delay = 0.0
             for a, d in enumerate(
                 self.injector.straggler_delays_for_round(nloop, gid, cfg.nadmm)
             ):
                 if d > 0:
+                    if cfg.round_deadline is not None:
+                        # deadline rounds cap the coordinator's wait: past
+                        # the deadline the round closes without the
+                        # straggler instead of stalling for it
+                        d = min(d, cfg.round_deadline)
                     self.recorder.step_time(
                         "straggler_wait", d, nloop=nloop, group=gid, nadmm=a
                     )
@@ -1431,8 +1672,29 @@ class Trainer:
                     # sentinel fired, serves the full schedule like the
                     # unfused one)
                     break
-            if total_delay > 0:
-                time.sleep(total_delay)
+        if total_delay > 0 and rollback:
+            # rollback keeps the pre-dispatch stall: the transactional
+            # round's observable ordering (coordinator waits out the
+            # stragglers, THEN the round's work runs and is judged) must
+            # not change — a rolled-back round's wall must still include
+            # the stall it provoked, not hide it under discarded compute
+            time.sleep(total_delay)
+        hetero = self._hetero_enabled()
+        ragged = self._ragged_enabled()
+        total_steps = self._round_total_steps()
+        budgets_np = times_np = None
+        budget_args = ()
+        if hetero:
+            _, budgets_np, times_np = self._round_hetero(nloop, gid)
+        if ragged:
+            budget_args = (
+                self._put(
+                    budgets_np,
+                    NamedSharding(
+                        self.mesh, PartitionSpec(None, CLIENT_AXIS)
+                    ),
+                ),
+            )
         masks = self._put(
             masks_np,
             NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS)),
@@ -1465,8 +1727,16 @@ class Trainer:
              losses_d, met, param_ok_d, qstats_d, snaps, correct_d) = round_fn(
                 self.flat, lstate, self.stats, self.shard_imgs,
                 self.shard_labels, idx, self.mean, self.std,
-                y, z, rho, extra, masks, *corr_args, *eval_args,
+                y, z, rho, extra, masks, *budget_args, *corr_args,
+                *eval_args,
             )
+            if total_delay > 0 and not rollback:
+                # the round is already ENQUEUED (dispatch is
+                # asynchronous): serving the coordinator's straggler wait
+                # here overlaps the device computing the round instead of
+                # delaying its start — the stall costs wall time only
+                # where it exceeds the round's own compute
+                time.sleep(total_delay)
             # device->host fetch of an output is the completion barrier
             # (the telemetry series is needed host-side regardless)
             losses = self._fetch(losses_d)  # [nadmm, nepoch, S, K]
@@ -1500,6 +1770,12 @@ class Trainer:
                         losses[a, e], nloop=nloop, group=gid, nadmm=a, epoch=e
                     )
             if cfg.strategy != "none":
+                if hetero:
+                    self._record_hetero(
+                        times_np[a],
+                        budgets_np[a] if budgets_np is not None else None,
+                        nloop=nloop, gid=gid, a=a, total=total_steps,
+                    )
                 self.recorder.residuals(
                     float(primal[a]) if is_admm else None,
                     float(dual[a]),
@@ -1513,15 +1789,19 @@ class Trainer:
                     )
                 # same comm accounting as the unfused path, one record per
                 # consensus iteration of the fused scan (obs/ledger.py):
-                # every transmitting (plan-alive) client's bytes, with a
-                # quarantined sender's attributed as wasted
+                # every transmitting (plan-alive, deadline-making)
+                # client's bytes, with a quarantined sender's attributed
+                # as wasted
+                transmit = masks_np[a]
+                if ragged:
+                    transmit = transmit * (budgets_np[a] > 0)
                 quarantined_now = (
-                    int((masks_np[a] * (1.0 - qmask_np)).sum())
+                    int((transmit * (1.0 - qmask_np)).sum())
                     if quarantine
                     else 0
                 )
                 self._comm.record(
-                    self.recorder, gid, int(masks_np[a].sum()),
+                    self.recorder, gid, int(transmit.sum()),
                     nloop=nloop, nadmm=a, quarantined=quarantined_now,
                 )
                 if quarantine:
@@ -1621,6 +1901,15 @@ class Trainer:
                     self.group_order,
                     cfg.nadmm,
                     exchanges=cfg.strategy != "none",
+                    total_steps=self._round_total_steps(),
+                    # deadline rows only where deadline rounds are active
+                    # (_ragged_enabled — strategy 'none' has no exchange
+                    # to miss the deadline of)
+                    deadline_s=(
+                        cfg.round_deadline
+                        if self._ragged_enabled()
+                        else None
+                    ),
                 )
                 if self.injector is not None
                 else {"drops": 0, "stragglers": 0, "crashes": 0,

@@ -17,7 +17,10 @@ The subsystem has three parts, stitched into the engine by `Trainer`:
 See docs/FAULT.md for the replay/resume guarantees.
 """
 
-from federated_pytorch_test_tpu.fault.injector import FaultInjector
+from federated_pytorch_test_tpu.fault.injector import (
+    FaultInjector,
+    step_budgets,
+)
 from federated_pytorch_test_tpu.fault.plan import (
     CORRUPT_MODES,
     CrashPoint,
@@ -31,4 +34,5 @@ __all__ = [
     "FaultInjector",
     "FaultPlan",
     "InjectedCrash",
+    "step_budgets",
 ]

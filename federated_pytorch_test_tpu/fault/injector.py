@@ -25,6 +25,34 @@ import numpy as np
 from federated_pytorch_test_tpu.fault.plan import FaultPlan, InjectedCrash
 
 
+def step_budgets(
+    speeds: np.ndarray,
+    step_time_s: float,
+    total_steps: int,
+    deadline_s: float,
+) -> np.ndarray:
+    """Inner-step budgets under a round deadline (int32, `speeds`' shape).
+
+    Each client can afford `floor(deadline / (step_time_s * speed))` of
+    its `total_steps` lockstep inner steps before the deadline, clipped
+    to `[0, total_steps]`. THE one definition of the conversion: the
+    trainer's budget rows, `step_budgets_for_round`, and the scoreboard
+    (`injected_summary`) all call it — a drifted copy would let the
+    compiled round run different budgets than the `step_budget` stream
+    and the `deadline_misses=` scoreboard report, silently breaking the
+    resume-proof same-totals guarantee.
+
+    The quotient is computed in float64 with a tiny absolute epsilon
+    before the floor: a deadline set to EXACTLY n steps' time must
+    yield budget n, not n-1 — with a non-representable decimal
+    step_time (0.3, 0.9/0.3 = 2.99999...) a bare floor would falsely
+    flag nominal-speed clients as deadline misses and break the
+    all-full-budget bitwise-identity regime (docs/FAULT.md).
+    """
+    q = deadline_s / (step_time_s * speeds.astype(np.float64))
+    return np.clip(np.floor(q + 1e-9), 0, total_steps).astype(np.int32)
+
+
 class FaultInjector:
     """Per-run fault dispenser for one `FaultPlan`."""
 
@@ -42,6 +70,12 @@ class FaultInjector:
             raise ValueError(
                 f"fault plan's corrupt_k={plan.corrupt_k} exceeds "
                 f"n_clients={n_clients}: cannot corrupt more clients "
+                "than exist per round"
+            )
+        if plan.slow_k > n_clients:
+            raise ValueError(
+                f"fault plan's slow_k={plan.slow_k} exceeds "
+                f"n_clients={n_clients}: cannot slow more clients "
                 "than exist per round"
             )
         self.state_dir = os.path.abspath(state_dir) if state_dir else None
@@ -108,8 +142,58 @@ class FaultInjector:
             np.stack([r[2] for r in rows]),
         )
 
+    @property
+    def has_heterogeneity(self) -> bool:
+        """Whether the plan schedules slow clients at all (the
+        tail-latency telemetry gate: homogeneous, deadline-free runs
+        record no client_time series — engine/trainer.py)."""
+        return self.plan.has_heterogeneity
+
+    def speeds_for_round(self, nloop: int, gid: int, nadmm: int) -> np.ndarray:
+        """`[nadmm, K]` per-step time multipliers for a whole partition
+        round, stacked like `masks_for_round` — pure in (plan seed,
+        cursor), so fused/unfused/resumed runs replay identical speeds.
+        """
+        return np.stack(
+            [
+                self.plan.client_speeds(self.n_clients, nloop, gid, a)
+                for a in range(nadmm)
+            ]
+        )
+
+    def step_budgets_for_round(
+        self,
+        nloop: int,
+        gid: int,
+        nadmm: int,
+        total_steps: int,
+        deadline_s: float,
+    ) -> np.ndarray:
+        """`[nadmm, K]` int32 inner-step budgets under a round deadline.
+
+        Each client can afford `floor(deadline / (step_time_s * speed))`
+        of its `total_steps` lockstep inner steps before the deadline —
+        clipped to `[0, total_steps]`. A budget BELOW total_steps is a
+        deadline miss (the client contributes a partial update); a ZERO
+        budget means not even one step finished in time, so no report
+        exists and the client is excluded from that exchange like a
+        dropped one (engine/trainer.py, docs/FAULT.md §Heterogeneity).
+        """
+        return step_budgets(
+            self.speeds_for_round(nloop, gid, nadmm),
+            self.plan.step_time_s,
+            total_steps,
+            deadline_s,
+        )
+
     def injected_summary(
-        self, nloops: int, group_order, nadmm: int, exchanges: bool = True
+        self,
+        nloops: int,
+        group_order,
+        nadmm: int,
+        exchanges: bool = True,
+        total_steps: int | None = None,
+        deadline_s: float | None = None,
     ) -> dict:
         """Fault counts over the experiment's full round schedule.
 
@@ -122,8 +206,17 @@ class FaultInjector:
         one) — for strategy-'none' runs, which hold no consensus
         exchange to apply them to; only the crash schedule fires either
         way. Feeds the CLI's end-of-run `# faults injected:` line.
+
+        With `deadline_s` (and the round's `total_steps`) the scoreboard
+        grows the deadline rows: `deadline_misses` counts every
+        (exchange, client) whose step budget fell short of the lockstep
+        step count, and `capped_stalls` every straggler stall the
+        deadline capped (the host serves `min(delay, deadline)` —
+        engine/trainer.py). Both are pure in the plan + deadline, so a
+        resumed run prints the same totals.
         """
         drops = stragglers = crashes = corruptions = 0
+        deadline_misses = capped_stalls = 0
         for nloop in range(nloops):
             for gid in group_order:
                 for a in range(nadmm):
@@ -136,16 +229,35 @@ class FaultInjector:
                             self.n_clients, nloop, gid, a
                         )
                         corruptions += int((modes != 0).sum())
-                        if self.plan.straggler_delay(nloop, gid, a) > 0:
+                        delay = self.plan.straggler_delay(nloop, gid, a)
+                        if delay > 0:
                             stragglers += 1
+                            if deadline_s is not None and delay > deadline_s:
+                                capped_stalls += 1
+                        if deadline_s is not None and total_steps:
+                            budgets = step_budgets(
+                                self.plan.client_speeds(
+                                    self.n_clients, nloop, gid, a
+                                ),
+                                self.plan.step_time_s,
+                                total_steps,
+                                deadline_s,
+                            )
+                            deadline_misses += int(
+                                (budgets < total_steps).sum()
+                            )
                     if self.plan.crash_at(nloop, gid, a) is not None:
                         crashes += 1
-        return {
+        counts = {
             "drops": drops,
             "stragglers": stragglers,
             "crashes": crashes,
             "corruptions": corruptions,
         }
+        if deadline_s is not None:
+            counts["deadline_misses"] = deadline_misses
+            counts["capped_stalls"] = capped_stalls
+        return counts
 
     def straggler_delays_for_round(
         self, nloop: int, gid: int, nadmm: int

@@ -36,6 +36,20 @@ Four fault kinds:
   mode/strength/seed arrays the fused round consumes as scan inputs
   (engine/steps.py) — and the defense lives in consensus/robust.py
   (`--robust-agg median|trimmed|clip`, auto-quarantine).
+
+Plus one SPEED axis (system heterogeneity, not a failure):
+
+* **slow clients** — each round, chosen clients run at `slow_factor`×
+  the nominal per-step time (`slow=<k-or-p>[:factor]`; exactly-k or
+  Bernoulli-p victims, like corruption). A nominal inner step takes
+  `step_time_s` SIMULATED seconds, so client k needs
+  `steps * step_time_s * speed_k` simulated seconds for its local work.
+  On its own the axis only produces tail-latency telemetry; combined
+  with a round deadline (`--round-deadline`, engine/config.py) the
+  injector converts each client's speed into the inner-step budget it
+  can afford before the deadline — ragged local work inside the round
+  program (engine/steps.py), partial updates instead of a stalled
+  cohort (docs/FAULT.md §Heterogeneity).
 """
 
 from __future__ import annotations
@@ -94,23 +108,34 @@ class FaultPlan:
     corrupt_k: int = 0
     corrupt_mode: str = "scale"
     corrupt_strength: float = 10.0
+    # compute-speed heterogeneity: either EXACTLY `slow_k` clients per
+    # round (chosen by the round's rng) or each client independently
+    # with `slow_p` run at `slow_factor`x the nominal per-step time.
+    # `step_time_s` is the SIMULATED seconds one nominal inner step
+    # costs — the unit that converts a round deadline into per-client
+    # step budgets (fault/injector.py step_budgets_for_round).
+    slow_p: float = 0.0
+    slow_k: int = 0
+    slow_factor: float = 3.0
+    step_time_s: float = 1.0
 
     def __post_init__(self):
         # types FIRST, so a wrong-typed field (a JSON plan with
         # corrupt_k: 2.5 or dropout_p: "0.3") fails HERE naming the
         # field, not rounds later inside numpy with an opaque TypeError
-        for name in ("seed", "corrupt_k"):
+        for name in ("seed", "corrupt_k", "slow_k"):
             v = getattr(self, name)
             if isinstance(v, bool) or not isinstance(v, int):
                 raise ValueError(f"{name} must be an int, got {v!r}")
         for name in (
             "dropout_p", "straggler_p", "straggler_delay_s",
             "corrupt_p", "corrupt_strength",
+            "slow_p", "slow_factor", "step_time_s",
         ):
             v = getattr(self, name)
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError(f"{name} must be a number, got {v!r}")
-        for name in ("dropout_p", "straggler_p", "corrupt_p"):
+        for name in ("dropout_p", "straggler_p", "corrupt_p", "slow_p"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -134,11 +159,29 @@ class FaultPlan:
                 f"corrupt_strength must be finite and >= 0, "
                 f"got {self.corrupt_strength}"
             )
+        if self.slow_k < 0:
+            raise ValueError(f"slow_k must be >= 0, got {self.slow_k}")
+        if not (np.isfinite(self.slow_factor) and self.slow_factor >= 1.0):
+            # < 1 would be a FAST client; the axis models stragglers, and
+            # a sub-nominal multiplier would silently let a deadline
+            # GROW a budget past the lockstep step count
+            raise ValueError(
+                f"slow_factor must be finite and >= 1, got {self.slow_factor}"
+            )
+        if not (np.isfinite(self.step_time_s) and self.step_time_s > 0):
+            raise ValueError(
+                f"step_time_s must be finite and > 0, got {self.step_time_s}"
+            )
 
     @property
     def has_corruption(self) -> bool:
         """Whether any round of this plan can corrupt an update."""
         return self.corrupt_p > 0.0 or self.corrupt_k > 0
+
+    @property
+    def has_heterogeneity(self) -> bool:
+        """Whether any round of this plan can slow a client down."""
+        return self.slow_p > 0.0 or self.slow_k > 0
 
     # ------------------------------------------------------------- schedule
 
@@ -215,6 +258,40 @@ class FaultPlan:
         seeds[:] = rng.integers(0, 2**31 - 1, n_clients, dtype=np.int64)
         return modes, strengths, seeds
 
+    def client_speeds(
+        self, n_clients: int, nloop: int, gid: int, nadmm: int
+    ) -> np.ndarray:
+        """`[K]` float32 per-step TIME multipliers (1.0 = nominal speed).
+
+        A slow client's inner step takes `slow_factor * step_time_s`
+        simulated seconds instead of `step_time_s`. Pure in (seed,
+        cursor) like every other axis — a separate seed fold (+3), so
+        adding heterogeneity to a plan perturbs none of its dropout
+        masks, straggler schedule, or corruption draws.
+        """
+        speeds = np.ones(n_clients, np.float32)
+        if not self.has_heterogeneity:
+            return speeds
+        rng = np.random.default_rng(
+            [(self.seed + 3) & 0x7FFFFFFF, nloop, gid, nadmm]
+        )
+        if self.slow_k > 0:
+            if self.slow_k > n_clients:
+                # same contract as corruption: direct plan users must not
+                # get a silent every-client cap where the engine path
+                # (FaultInjector) gets a ValueError
+                raise ValueError(
+                    f"slow_k={self.slow_k} exceeds n_clients={n_clients}: "
+                    "cannot slow more clients than exist per round"
+                )
+            chosen = rng.choice(n_clients, size=self.slow_k, replace=False)
+            hit = np.zeros(n_clients, bool)
+            hit[chosen] = True
+        else:
+            hit = rng.random(n_clients) < self.slow_p
+        speeds[hit] = self.slow_factor
+        return speeds
+
     def crash_at(self, nloop: int, gid: int, nadmm: int) -> CrashPoint | None:
         for c in self.crashes:
             if (c.nloop, c.gid, c.nadmm) == (nloop, gid, nadmm):
@@ -287,7 +364,9 @@ class FaultPlan:
         schedules update corruption: an INT first part corrupts exactly
         that many clients per round (`corrupt_k`), a FLOAT is the
         per-client probability (`corrupt_p`); mode is one of
-        scale|signflip|nan_burst|gauss.
+        scale|signflip|nan_burst|gauss. `slow=<k-or-p>[:factor]` (same
+        int-vs-float convention) schedules the compute-speed axis, and
+        `step_time=<seconds>` sets the simulated nominal per-step time.
         """
         if os.path.exists(spec):
             with open(spec) as f:
@@ -335,9 +414,25 @@ class FaultPlan:
                 kw["corrupt_mode"] = parts[1]
                 if len(parts) == 3:
                     kw["corrupt_strength"] = float(parts[2])
+            elif key == "slow":
+                parts = val.split(":")
+                if not 1 <= len(parts) <= 2:
+                    raise ValueError(
+                        f"slow spec {val!r} must be <k-or-p>[:factor]"
+                    )
+                amount = parts[0]
+                if "." in amount or "e" in amount.lower():
+                    kw["slow_p"] = float(amount)
+                else:
+                    kw["slow_k"] = int(amount)
+                if len(parts) == 2:
+                    kw["slow_factor"] = float(parts[1])
+            elif key == "step_time":
+                kw["step_time_s"] = float(val)
             else:
                 raise ValueError(
                     f"unknown fault-plan key {key!r} "
-                    "(have seed, dropout, straggler, crash, corrupt)"
+                    "(have seed, dropout, straggler, crash, corrupt, "
+                    "slow, step_time)"
                 )
         return cls(crashes=tuple(crashes), **kw)

@@ -393,6 +393,65 @@ class MetricsRecorder:
                 f"nadmm={nadmm}"
             )
 
+    def client_times(self, pct: dict, *, nloop, group, nadmm) -> None:
+        """Simulated client-time tail of one consensus round's local work.
+
+        `pct` carries the per-client time percentiles (`p50`/`p95`/`p99`,
+        seconds of SIMULATED compute: steps × step_time × speed —
+        fault/plan.py's speed axis), the slowest client (`max`) and the
+        round's simulated wall `round` — `min(max, deadline)` when
+        deadline rounds are on, since the coordinator closes the round
+        at the deadline instead of waiting out the tail. Recorded only
+        for heterogeneous or deadline runs, so homogeneous streams stay
+        byte-identical (engine/trainer.py `_hetero_enabled`).
+        """
+        vals = {k: float(v) for k, v in pct.items()}
+        self.log("client_time", vals, nloop=nloop, group=group, nadmm=nadmm)
+        if self.verbose:
+            print(
+                f"client_time nloop={nloop} group={group} nadmm={nadmm} "
+                + " ".join(f"{k}={v:.3f}" for k, v in vals.items())
+            )
+
+    def step_budgets(self, budgets, *, nloop, group, nadmm) -> None:
+        """Per-client inner-step budgets of one deadline round (`[K]`).
+
+        What each client could afford before the round deadline
+        (fault/injector.py `step_budgets_for_round`); a value below the
+        lockstep step count is a deadline miss, zero means the client's
+        report never arrived. Only recorded under `--round-deadline`.
+        """
+        vals = [int(b) for b in budgets]
+        self.log("step_budget", vals, nloop=nloop, group=group, nadmm=nadmm)
+        if self.verbose:
+            print(
+                f"step_budget nloop={nloop} group={group} nadmm={nadmm} "
+                + ",".join(str(v) for v in vals)
+            )
+
+    def deadline_miss(self, clients, *, nloop, group, nadmm) -> None:
+        """Clients whose step budget fell short of the full lockstep
+        count at one exchange — they contributed a PARTIAL update (or,
+        at budget zero, none at all). Mirrors `quarantine` (trace
+        instant + grep-able line) but is its own series: a miss is
+        graceful degradation, not a failure or a defense.
+        """
+        ids = [int(c) for c in clients]
+        self.log(
+            "deadline_miss", {"clients": ids}, nloop=nloop, group=group,
+            nadmm=nadmm,
+        )
+        if self.tracer is not None:
+            self.tracer.instant(
+                "fault:deadline_miss", clients=ids, nloop=nloop, group=group,
+                nadmm=nadmm,
+            )
+        if self.verbose:
+            print(
+                f"DEADLINE_MISS clients={ids} nloop={nloop} group={group} "
+                f"nadmm={nadmm}"
+            )
+
     def group_distance(self, dists, *, nloop, group) -> None:
         """Per-group distance-from-mean diagnostic (`[num_groups]`).
 

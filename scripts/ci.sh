@@ -25,11 +25,24 @@
 #                                    sweeps, resnet-engine runs,
 #                                    streaming-equivalence, Pallas
 #                                    interpret kernels, ring, 2- and
-#                                    4-process distributed runs, plus the
-#                                    CLI chaos smoke below (corruption
-#                                    plan + trimmed combiner + quarantine
-#                                    + planned crash, recovered end to
-#                                    end with --resume auto)
+#                                    4-process distributed runs, the
+#                                    heavy heterogeneity contracts
+#                                    (tests/test_hetero.py slow tier:
+#                                    admm/BB uniform-budget bitwise,
+#                                    ragged + corruption + trimmed +
+#                                    quarantine composition, crash/
+#                                    resume stream identity with
+#                                    deadline records), plus the CLI
+#                                    smokes below: chaos_smoke
+#                                    (corruption plan + trimmed combiner
+#                                    + quarantine + planned crash,
+#                                    recovered end to end with --resume
+#                                    auto) and hetero_smoke (speed-
+#                                    heterogeneous plan + round deadline
+#                                    + trimmed combiner + planned crash,
+#                                    recovered via rerun, crashed+resumed
+#                                    stream identical to the
+#                                    uninterrupted twin's)
 #
 # Usage:
 #   scripts/ci.sh            # tier 1 then tier 2 (both tiers, full CI)
@@ -81,6 +94,81 @@ chaos_smoke() {
   rm -rf "$d"
 }
 
+hetero_smoke() {
+  # End-to-end deadline rounds through the REAL CLI: one 3x slow client
+  # per round (speed axis), a round deadline at the nominal full-work
+  # time (4 lockstep steps at batch 20: the slow client's budget is 1 —
+  # a PARTIAL contribution every exchange), the trimmed combiner riding
+  # along, and a planned crash at (nloop=1, gid=2, nadmm=0) killing the
+  # first run. Recovery is rerunning the IDENTICAL command; an
+  # uninterrupted twin (same plan minus the crash point) then proves
+  # crashed+resumed stream identity — client_time/step_budget/
+  # deadline_miss records included — modulo wall-clock fields and the
+  # header tag the twins legitimately differ in.
+  local d; d="$(mktemp -d)"
+  local common=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 240 --synthetic-n-test 60 --batch 20
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --round-deadline 4 --robust-agg trimmed --robust-f 1
+    --fault-mode rollback --save-model --resume auto)
+  local cmd=("${common[@]}"
+    --fault-plan "seed=6,slow=1:3,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${common[@]}"
+    --fault-plan "seed=6,slow=1:3"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  echo "hetero smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "hetero smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "hetero smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "hetero smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "hetero smoke FAILED: the uninterrupted twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  # 2 nloops x 1 group x 2 exchanges, the one slow client misses each
+  grep -q '# faults injected: .*deadline_misses=4' "$d/run2.log" || {
+    echo "hetero smoke FAILED: missing/incorrect deadline scoreboard" >&2
+    grep '# faults' "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  if grep -q 'round_rollback' "$d/run.jsonl"; then
+    echo "hetero smoke FAILED: partial updates tripped a rollback" >&2
+    rm -rf "$d"; return 1
+  fi
+  # stream identity: the crashed+resumed stream equals the twin's modulo
+  # wall-clock fields and the header tag (the plans differ by the crash)
+  python - "$d/run.jsonl" "$d/twin.jsonl" <<'PY' || {
+import json, sys
+
+def norm(path):
+    out = []
+    for line in open(path):
+        d = json.loads(line)
+        d.pop("t", None)
+        if d.get("event") == "stream_header":
+            d.pop("tag", None)
+        if d.get("series") == "step_time":
+            d["value"] = {k: v for k, v in d["value"].items() if k != "seconds"}
+        out.append(d)
+    return out
+
+a, b = norm(sys.argv[1]), norm(sys.argv[2])
+assert a == b, f"streams differ: {len(a)} vs {len(b)} records"
+assert any(d.get("series") == "deadline_miss" for d in a)
+assert any(d.get("series") == "client_time" for d in a)
+PY
+    echo "hetero smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "hetero smoke OK"
+  rm -rf "$d"
+}
+
 tier="${CI_TIER:-all}"
 case "$tier" in
   0) python -m pytest tests/ -m smoke -q "$@" ;;
@@ -88,11 +176,13 @@ case "$tier" in
   2)
     python -m pytest tests/ -m slow -q "$@"
     chaos_smoke
+    hetero_smoke
     ;;
   all)
     python -m pytest tests/ -m 'not slow' -q "$@"
     python -m pytest tests/ -m slow -q "$@"
     chaos_smoke
+    hetero_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;
 esac
