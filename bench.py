@@ -373,6 +373,53 @@ def _hetero_probe():
     }
 
 
+def _cohort_probe():
+    """Cohort-mode wall vs virtual-population size N at fixed cohort C.
+
+    The cross-device scale claim (clients/, docs/SCALE.md) is that
+    per-round cost depends on the COHORT, not the population: N virtual
+    clients live in the host store and only C gathered rows ever touch a
+    device, so the warm round wall at N=64 and N=1024 must match.
+    `cohort_scaling` is the small-N/large-N median-round-time ratio —
+    1.0 is perfectly flat, below ~0.9 means per-round cost is leaking an
+    O(N) term (gather, sampler, or store bookkeeping). Medianized over
+    three warm gather→round→scatter loops per row, same discipline as
+    the other probes.
+    """
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    c = 4
+    src = synthetic_cifar(n_train=c * 40 * 2, n_test=60)
+    times = {}
+    for n_virtual in (64, 1024):
+        cfg = get_preset(
+            "fedavg", batch=40, nloop=4, nadmm=2, max_groups=1,
+            model="net", check_results=False, synthetic_ok=True,
+            virtual_clients=n_virtual, cohort=c, data_shards=c,
+        )
+        tr = Trainer(cfg, verbose=False, source=src)
+        tr.run_loop(0)  # warmup: compile-dominated
+        dts = []
+        for nloop in range(1, 4):
+            t0 = time.perf_counter()
+            tr.run_loop(nloop)  # one gather -> round -> scatter cycle
+            dts.append(time.perf_counter() - t0)
+        times[n_virtual] = float(np.median(dts))
+        tr.close()
+    return {
+        "cohort": c,
+        "virtual_clients_small": 64,
+        "virtual_clients_large": 1024,
+        "round_time_n64_s": round(times[64], 4),
+        "round_time_n1024_s": round(times[1024], 4),
+        # ≈1.0 when per-round cost is flat in N (the scale contract)
+        "cohort_scaling": round(times[64] / times[1024], 3),
+    }
+
+
 def main() -> None:
     bench_device = os.environ.get("BENCH_DEVICE", "")
     if bench_device == "cpu":
@@ -467,6 +514,12 @@ def main() -> None:
         out["hetero"] = _hetero_probe()
     except Exception as e:  # a failed probe must not kill the bench
         out["hetero"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # ---- the cohort probe: round wall flat in virtual-population N ----
+    try:
+        out["cohort"] = _cohort_probe()
+    except Exception as e:  # a failed probe must not kill the bench
+        out["cohort"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # ---- the utilization sweep: batch and model-size levers ----
     # (round-2 VERDICT: "no row anywhere shows MFU climbing with batch or
@@ -627,6 +680,11 @@ def main() -> None:
     headline["deadline_speedup"] = out.get("hetero", {}).get(
         "deadline_speedup"
     )
+    # the cross-device scale fact (virtual-client cohort PR): warm
+    # gather→round→scatter wall ratio at N=64 vs N=1024 with C fixed —
+    # ≈1.0 means per-round cost depends on the cohort, not the
+    # virtual-population size (clients/, docs/SCALE.md)
+    headline["cohort_scaling"] = out.get("cohort", {}).get("cohort_scaling")
     if "mxu_probe" in out:
         headline["mxu_pct_peak"] = out["mxu_probe"]["pct_peak"]
         headline["mxu_probe_valid"] = out["mxu_probe"]["valid"]

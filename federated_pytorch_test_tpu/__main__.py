@@ -40,6 +40,16 @@ contribute partial updates instead of stalling the cohort, and
 tail-latency percentiles land in the `client_time` series
 (docs/FAULT.md §Heterogeneity).
 
+Cross-device scale (clients/, docs/SCALE.md): `--virtual-clients N
+--cohort C` models a population of N virtual clients in a host-side
+chunked store; each outer loop a seeded replayable cohort of C clients
+(`--cohort-seed`, `--cohort-weighting uniform|samples|identity`) is
+gathered into the same one-dispatch round program and scattered back,
+with `--data-shards S` mapping the population onto S disjoint data
+shards. Fault schedules stay keyed by virtual-client id, checkpoints
+write only dirty store chunks (O(C) per loop), and crash recovery
+replays the identical cohort sequence.
+
 Observability (obs/, docs/OBSERVABILITY.md) rides it too:
 `--metrics-stream run.jsonl` streams every metric record to a crash-safe
 JSONL file that `--resume auto` continues seamlessly, `--trace-out
@@ -110,6 +120,15 @@ def _print_summary(recorder, cfg) -> None:
                 f"(uplink/floor {comm['vs_data_floor']})"
             )
         print(line)
+    part = recorder.latest("cohort_participation")
+    if part is not None:
+        print(
+            f"# cohort: {part['cohort']} of {part['n_virtual']} virtual "
+            f"clients per loop over {part['loops']} loops; "
+            f"{part['sampled_ever']} ever sampled "
+            f"(per-client min={part['min']} max={part['max']} "
+            f"mean={part['mean']})"
+        )
     inj = recorder.latest("injected_faults")
     if inj is not None:
         # the chaos scoreboard: scheduled kinds come from the pure plan

@@ -1,0 +1,30 @@
+"""Virtual clients: the cross-device scale layer (docs/SCALE.md).
+
+Turns the engine's cross-*silo* shape (every configured client trains
+every round as device-resident `[K]` state) into the cross-*device* one
+(a host-side store of N ≫ K virtual clients, a seeded replayable cohort
+of C gathered into the unchanged one-dispatch round program each outer
+loop, survivors scattered back):
+
+* `ClientStore` (store.py) — chunked, lazily-materialized host state
+  with O(C)-per-loop dirty-chunk checkpointing;
+* `CohortSampler` (cohort.py) — the participation schedule, pure in
+  `(seed, nloop)` like a `fault.FaultPlan`, riding the shared
+  SEED_FOLDS registry.
+
+The engine wires both in `engine/trainer.py` (`--virtual-clients N
+--cohort C`); fault schedules stay keyed by VIRTUAL client id, so a
+client's chaos identity follows it across cohorts (docs/FAULT.md).
+"""
+
+from federated_pytorch_test_tpu.clients.cohort import (
+    WEIGHTINGS,
+    CohortSampler,
+)
+from federated_pytorch_test_tpu.clients.store import ClientStore
+
+__all__ = [
+    "ClientStore",
+    "CohortSampler",
+    "WEIGHTINGS",
+]

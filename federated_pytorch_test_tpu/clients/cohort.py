@@ -1,0 +1,154 @@
+"""Seeded, replayable cohort sampling over a virtual-client population.
+
+Cross-*device* federated learning is partial participation by
+construction: a server holds state for N mostly-idle virtual clients and
+each round only a small cohort of C actually trains — TAMUNA
+(arXiv:2302.09832) is the algorithmic anchor for this regime, and FedADMM
+(arXiv:2204.03529) shows the ADMM consensus the engine already runs
+tolerates exactly this kind of partial, heterogeneous participation (the
+fault layer's participation masks supply the aggregation-under-absence
+semantics).
+
+A `CohortSampler` is the *schedule* of that participation and nothing
+else, designed with the same purity contract as `fault.FaultPlan`: the
+cohort of outer loop `nloop` is a pure function of `(seed, nloop)` alone
+— no execution history, no RNG object threaded across calls — so a
+crashed-and-resumed run re-derives every historical cohort exactly, the
+trainer's resume path can reconstruct skipped loops' communication
+totals, and fused/unfused/restarted runs all train the identical cohort
+sequence. The sampler claims the "cohort" slot of the shared seed-fold
+registry (fault/plan.py SEED_FOLDS): even an operator who points
+`--cohort-seed` and the fault plan's seed at the same value gets
+independent cohort and dropout draws.
+
+Cohort SLOT ORDER is ascending virtual-client id. The engine's compiled
+round program is slot-indexed (a `[C]`-leading client axis sharded over
+the mesh — parallel/mesh.py), so some canonical id→slot order is needed;
+ascending order makes gather/scatter locality best-case for the chunked
+store and keeps the mapping independent of the draw algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from federated_pytorch_test_tpu.fault.plan import fold_seed
+
+WEIGHTINGS = ("uniform", "samples", "identity")
+
+
+class CohortSampler:
+    """Draw the cohort of each outer loop, purely in `(seed, nloop)`.
+
+    * `uniform`  — C of N without replacement, equal probability;
+    * `samples`  — C of N without replacement, probability proportional
+      to each virtual client's sample count (clients holding more data
+      are seen more often — the weighting FedAvg's convergence analysis
+      assumes when shards are unbalanced);
+    * `identity` — the degenerate full-participation schedule
+      (requires C == N): every loop trains `arange(N)`. This is the
+      bitwise bridge to the pre-cohort engine — N=K, C=K, identity
+      reproduces the legacy every-client-every-round trajectory exactly
+      (tests/test_clients.py).
+    """
+
+    def __init__(
+        self,
+        n_virtual: int,
+        cohort: int,
+        seed: int = 0,
+        weighting: str = "uniform",
+        sample_counts: Optional[np.ndarray] = None,
+    ):
+        if n_virtual < 1:
+            raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
+        if not 1 <= cohort <= n_virtual:
+            raise ValueError(
+                f"cohort must be in [1, n_virtual={n_virtual}], got {cohort}"
+            )
+        if weighting not in WEIGHTINGS:
+            raise ValueError(
+                f"weighting must be one of {WEIGHTINGS}, got {weighting!r}"
+            )
+        if weighting == "identity" and cohort != n_virtual:
+            raise ValueError(
+                "identity weighting is full participation: cohort "
+                f"({cohort}) must equal n_virtual ({n_virtual})"
+            )
+        self.n_virtual = int(n_virtual)
+        self.cohort_size = int(cohort)
+        self.seed = int(seed)
+        self.weighting = weighting
+        self._p = None
+        if weighting == "samples":
+            if sample_counts is None:
+                raise ValueError(
+                    "weighting='samples' needs per-virtual-client "
+                    "sample_counts"
+                )
+            counts = np.asarray(sample_counts, np.float64).reshape(-1)
+            if counts.shape[0] != n_virtual:
+                raise ValueError(
+                    f"sample_counts has {counts.shape[0]} entries for "
+                    f"n_virtual={n_virtual}"
+                )
+            if not (np.isfinite(counts).all() and (counts > 0).all()):
+                raise ValueError(
+                    "sample_counts must be finite and positive (a "
+                    "zero-sample client could never be drawn, which is a "
+                    "store-construction bug, not a sampling policy)"
+                )
+            self._p = counts / counts.sum()
+
+    def _rng(self, nloop: int) -> np.random.Generator:
+        # the reserved "cohort" fold of the shared registry — see module
+        # docstring; same SeedSequence style as FaultPlan._rng
+        return np.random.default_rng([fold_seed(self.seed, "cohort"), nloop])
+
+    def cohort(self, nloop: int) -> np.ndarray:
+        """`[C]` int64 virtual-client ids of outer loop `nloop`, ascending.
+
+        Pure in `(seed, nloop)`: two calls — in different processes,
+        before and after a crash, with any interleaving — return the
+        identical array. The last loop's draw is memoized (purity makes
+        the cache transparent): the trainer re-derives the cohort at
+        every fault-schedule projection of the loop. Callers must treat
+        the returned array as read-only.
+        """
+        cached = getattr(self, "_memo", None)
+        if cached is not None and cached[0] == nloop:
+            return cached[1]
+        ids = self._draw(nloop)
+        self._memo = (nloop, ids)
+        return ids
+
+    def _draw(self, nloop: int) -> np.ndarray:
+        if self.weighting == "identity":
+            return np.arange(self.n_virtual, dtype=np.int64)
+        rng = self._rng(nloop)
+        ids = rng.choice(
+            self.n_virtual,
+            size=self.cohort_size,
+            replace=False,
+            p=self._p,
+            # the default (True) would permute all N ids per draw; at
+            # N ≫ C that is the sampler's whole cost. Floyd's algorithm
+            # draws C of N in O(C). Selection DISTRIBUTION per id is
+            # unchanged for uniform draws; the draw order differs, which
+            # the ascending slot order erases anyway.
+            shuffle=False,
+        )
+        return np.sort(ids.astype(np.int64))
+
+    def participation_counts(self, nloops: int) -> np.ndarray:
+        """`[N]` int64: how often each virtual client was sampled over
+        `nloops` outer loops — pure in (seed, nloops), so a resumed run
+        reports the same end-of-run participation summary as an
+        uninterrupted one (engine/trainer.py logs it as the
+        `cohort_participation` record)."""
+        counts = np.zeros(self.n_virtual, np.int64)
+        for nloop in range(nloops):
+            counts[self.cohort(nloop)] += 1
+        return counts

@@ -1,0 +1,423 @@
+"""Host-side virtual-client state store: N ≫ K clients, O(C) round cost.
+
+The pre-cohort engine holds every configured client's state as `[K]`
+device arrays — cross-*silo* simulation, where K is bounded by HBM and
+`benchmarks/client_scaling_tpu.json` shows per-client efficiency
+collapsing as K grows on one device. Cross-*device* federated learning
+inverts the shape: a server keeps state for thousands-to-millions of
+mostly-idle virtual clients on the HOST, and each round only the sampled
+cohort's rows ever touch a device (clients/cohort.py, engine/trainer.py
+gather → fused round → scatter).
+
+`ClientStore` is that host side. Three properties drive the design:
+
+* **Lazy chunks.** Client rows live in fixed-size chunks
+  (`chunk_clients` ids per chunk). A chunk is PRISTINE — represented by
+  nothing at all — until some row of it is first written; gathers from a
+  pristine chunk broadcast the per-field init row (cohort mode requires
+  the common-seed init, engine/config.py, so every virtual client starts
+  from the same row). Memory and checkpoint cost therefore scale with
+  the clients ever *touched*, not with N: a 1M-client store that has run
+  ten C=64 cohorts holds ≤ 640 materialized rows.
+
+* **Dirty-chunk checkpointing.** `save(dir, step)` writes ONLY the
+  chunks dirtied since the last save (one `.npz` per chunk, tmp+rename
+  like utils/checkpoint.py) plus a small JSON manifest mapping every
+  materialized chunk to its current file. The manifest write is the
+  atomic commit point: a crash mid-save leaves at worst orphaned chunk
+  files that the next save garbage-collects, never a torn snapshot —
+  the previous manifest still references the previous versions. Per-loop
+  checkpoint delta is O(C) (tests/test_clients.py asserts it), while a
+  naive store-in-the-orbax-tree design would rewrite O(N) every loop.
+
+* **Field registry.** A row is a set of named fields — `flat` (the
+  client's parameter vector), one per batch-stats leaf, and one per
+  partition group's persistent ADMM rho (`rho/<gid>`, registered lazily
+  the first time that group's round completes; see
+  engine/trainer.py `_rho_store`). L-BFGS history and the consensus
+  y/z duals are deliberately NOT stored: the engine re-initializes them
+  fresh at every partition round by construction (utils/checkpoint.py
+  module docstring), so persisting them would be dead weight per client.
+
+Static per-client metadata (data-shard assignment, per-shard sample
+counts) is computed once at construction and never checkpointed — it is
+a pure function of (N, n_shards, shard sizes), the same purity contract
+the cohort sampler and fault plans ride.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+_MANIFEST_VERSION = 1
+
+
+def _manifest_path(root: str, step: int) -> str:
+    return os.path.join(root, f"manifest_step_{step}.json")
+
+
+class ClientStore:
+    """Chunked, lazily-materialized `[N, ...]` per-field client state."""
+
+    def __init__(
+        self,
+        n_virtual: int,
+        shard_ids: np.ndarray,
+        sample_counts: np.ndarray,
+        chunk_clients: int = 256,
+    ):
+        if n_virtual < 1:
+            raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
+        if chunk_clients < 1:
+            raise ValueError(
+                f"chunk_clients must be >= 1, got {chunk_clients}"
+            )
+        self.n_virtual = int(n_virtual)
+        self.chunk_clients = int(chunk_clients)
+        self.shard_ids = np.asarray(shard_ids, np.int64).reshape(-1)
+        self.sample_counts = np.asarray(sample_counts, np.int64).reshape(-1)
+        if self.shard_ids.shape[0] != n_virtual:
+            raise ValueError(
+                f"shard_ids has {self.shard_ids.shape[0]} entries for "
+                f"n_virtual={n_virtual}"
+            )
+        if self.sample_counts.shape[0] != n_virtual:
+            raise ValueError(
+                f"sample_counts has {self.sample_counts.shape[0]} entries "
+                f"for n_virtual={n_virtual}"
+            )
+        # field name -> [*(row shape)] init row (the pristine value of
+        # every client's row of that field)
+        self._fills: Dict[str, np.ndarray] = {}
+        # chunk id -> {field name -> [rows_in_chunk, *(row shape)]};
+        # a chunk dict may lack fields registered after it materialized —
+        # those fall back to the fill row on gather
+        self._chunks: Dict[int, Dict[str, np.ndarray]] = {}
+        self._dirty: set = set()
+        self._files: Dict[int, str] = {}  # chunk id -> current filename
+        self._seq = 0  # monotone version counter for chunk filenames
+        # field metadata of a restored manifest: fields saved by the
+        # crashed run but not yet re-registered by this one (lazy rho
+        # fields) — validated at re-registration time
+        self._saved_fields: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------- fields
+
+    def register_field(self, name: str, fill_row: np.ndarray) -> None:
+        """Declare field `name` with its pristine per-client row.
+
+        Idempotent for an identical fill (re-registration happens on
+        resume); a *different* fill for an existing name is a caller bug
+        and raises — silently changing what pristine clients hold would
+        corrupt every never-sampled client.
+        """
+        row = np.asarray(fill_row)
+        if name in self._fills:
+            if (
+                self._fills[name].shape != row.shape
+                or self._fills[name].dtype != row.dtype
+                or not np.array_equal(
+                    self._fills[name], row, equal_nan=True
+                )
+            ):
+                raise ValueError(
+                    f"field {name!r} re-registered with a different fill "
+                    "row (shape/dtype/value mismatch)"
+                )
+            return
+        saved = self._saved_fields.get(name)
+        if saved is not None and (
+            list(row.shape) != list(saved["shape"])
+            or str(row.dtype) != saved["dtype"]
+        ):
+            raise ValueError(
+                f"client-store field {name!r} was saved with shape "
+                f"{saved['shape']} dtype {saved['dtype']} but this run "
+                f"registers shape {list(row.shape)} dtype {row.dtype}"
+            )
+        self._fills[name] = row.copy()
+
+    def has_field(self, name: str) -> bool:
+        return name in self._fills
+
+    @property
+    def fields(self):
+        return tuple(sorted(self._fills))
+
+    @property
+    def saved_fields(self) -> Dict[str, dict]:
+        """Field metadata a restored manifest recorded (`{name: {shape,
+        dtype}}`): what the crashed run had registered at its last save.
+        The trainer re-registers its lazy fields (per-group rho) from
+        this so restored chunks holding them stay addressable before the
+        group's first round of the resumed run."""
+        return dict(self._saved_fields)
+
+    # ------------------------------------------------------- gather/scatter
+
+    def _chunk_of(self, vid: int) -> int:
+        return int(vid) // self.chunk_clients
+
+    def _chunk_rows(self, cid: int) -> int:
+        lo = cid * self.chunk_clients
+        return min(self.chunk_clients, self.n_virtual - lo)
+
+    def _check_ids(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.n_virtual):
+            raise IndexError(
+                f"virtual-client ids out of range [0, {self.n_virtual}): "
+                f"min={ids.min()}, max={ids.max()}"
+            )
+        return ids
+
+    def gather(self, name: str, ids: np.ndarray) -> np.ndarray:
+        """Rows of field `name` for `ids`, as a fresh `[len(ids), ...]`
+        array (never a view into the store — the caller device_puts and
+        possibly donates it)."""
+        ids = self._check_ids(ids)
+        fill = self._fills[name]
+        out = np.empty((ids.size,) + fill.shape, fill.dtype)
+        for pos, vid in enumerate(ids):
+            cid = self._chunk_of(vid)
+            chunk = self._chunks.get(cid)
+            if chunk is None or name not in chunk:
+                out[pos] = fill
+            else:
+                out[pos] = chunk[name][int(vid) - cid * self.chunk_clients]
+        return out
+
+    def scatter(self, name: str, ids: np.ndarray, rows: np.ndarray) -> None:
+        """Write `rows[i]` into client `ids[i]`'s slot of field `name`,
+        materializing (init-filled) chunks as needed and marking every
+        touched chunk dirty for the next `save`."""
+        ids = self._check_ids(ids)
+        rows = np.asarray(rows)
+        fill = self._fills[name]
+        if rows.shape != (ids.size,) + fill.shape:
+            raise ValueError(
+                f"scatter of field {name!r}: rows shape {rows.shape} != "
+                f"{(ids.size,) + fill.shape}"
+            )
+        if rows.dtype != fill.dtype:
+            raise ValueError(
+                f"scatter of field {name!r}: dtype {rows.dtype} != "
+                f"registered {fill.dtype} (an implicit cast here would "
+                "silently change restored state)"
+            )
+        for pos, vid in enumerate(ids):
+            cid = self._chunk_of(vid)
+            chunk = self._chunks.setdefault(cid, {})
+            if name not in chunk:
+                chunk[name] = np.broadcast_to(
+                    fill, (self._chunk_rows(cid),) + fill.shape
+                ).copy()
+            chunk[name][int(vid) - cid * self.chunk_clients] = rows[pos]
+            self._dirty.add(cid)
+
+    def touched_chunks(self, ids: np.ndarray) -> set:
+        """Chunk ids a scatter of `ids` dirties (the O(C) bound of one
+        loop's checkpoint delta: ≤ len(ids) chunks + the manifest)."""
+        return {self._chunk_of(v) for v in self._check_ids(ids)}
+
+    # --------------------------------------------------------- checkpointing
+
+    # manifests retained per save: the newest one plus enough history to
+    # cover the crash window between a store save and its checkpoint's
+    # orbax commit (resume then falls back exactly one step). Retaining
+    # N manifests bounds disk at O(population touched) + N*O(C) chunk
+    # versions; without pruning, every superseded chunk version would
+    # stay referenced by some historical manifest forever.
+    keep_manifests: int = 2
+
+    def save(self, directory: str, step: int) -> str:
+        """Write the dirty chunks + the step manifest; return its path.
+
+        Called by `Trainer.save` BEFORE the orbax checkpoint of the same
+        step is committed: a crash between the two leaves this manifest
+        dangling (no checkpoint names it), and resume falls back to the
+        previous checkpoint + its manifest — both still intact, because
+        chunk files are versioned (`chunk_<cid>_v<seq>.npz`), never
+        overwritten in place. After the manifest commit, manifests older
+        than the newest `keep_manifests` are pruned and chunk files no
+        retained manifest references (superseded versions, crashed-save
+        orphans, stale `.tmp_` staging files) are garbage-collected —
+        resume therefore reaches the newest `keep_manifests` snapshots;
+        falling back further (multiple consecutive torn checkpoints)
+        fails loudly in `load` rather than restoring silently-wrong
+        rows.
+        """
+        root = os.path.abspath(os.path.join(directory, "client_store"))
+        os.makedirs(root, exist_ok=True)
+        for cid in sorted(self._dirty):
+            self._seq += 1
+            fname = f"chunk_{cid:06d}_v{self._seq:08d}.npz"
+            tmp = os.path.join(root, f".tmp_{fname}")
+            with open(tmp, "wb") as f:
+                np.savez(f, **self._chunks[cid])
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(root, fname))
+            self._files[cid] = fname
+        self._dirty.clear()
+        manifest = {
+            "version": _MANIFEST_VERSION,
+            "step": int(step),
+            "n_virtual": self.n_virtual,
+            "chunk_clients": self.chunk_clients,
+            "seq": self._seq,
+            "chunks": {str(c): f for c, f in sorted(self._files.items())},
+            "fields": {
+                name: {
+                    "shape": list(row.shape),
+                    "dtype": str(row.dtype),
+                }
+                for name, row in sorted(self._fills.items())
+            },
+        }
+        path = _manifest_path(root, step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._gc(root)
+        return path
+
+    def _gc(self, root: str) -> None:
+        """Prune old manifests, then delete unreferenced files.
+
+        Best-effort: any OS error leaves files behind for the next save
+        to reclaim, never fails the checkpoint. A torn (unparseable)
+        retained manifest aborts chunk GC entirely — its references are
+        unknowable, and deleting a chunk it might name would turn a
+        recoverable situation into data loss.
+        """
+        def is_manifest(entry: str) -> bool:
+            # committed manifests only: a crashed writer's staging file
+            # (`manifest_step_N.json.tmp`) is never authoritative — it
+            # is deleted below, not parsed, so it can't wedge GC forever
+            return entry.startswith("manifest_step_") and entry.endswith(
+                ".json"
+            )
+
+        steps = []
+        for entry in os.listdir(root):
+            if is_manifest(entry):
+                try:
+                    steps.append(int(entry[len("manifest_step_"):-5]))
+                except ValueError:
+                    continue
+        for s in sorted(steps)[: -self.keep_manifests]:
+            try:
+                os.remove(_manifest_path(root, s))
+            except OSError:
+                pass
+        referenced = set()
+        for entry in os.listdir(root):
+            if not is_manifest(entry):
+                continue
+            try:
+                with open(os.path.join(root, entry)) as f:
+                    referenced.update(json.load(f).get("chunks", {}).values())
+            except (OSError, ValueError):
+                return  # torn retained manifest: references unknowable
+        for entry in os.listdir(root):
+            stale = entry.startswith("chunk_") and entry not in referenced
+            if stale or entry.startswith(".tmp_") or entry.endswith(
+                ".json.tmp"
+            ):
+                try:
+                    os.remove(os.path.join(root, entry))
+                except OSError:
+                    pass
+
+    def load(self, directory: str, step: int) -> None:
+        """Restore the snapshot `save(directory, step)` committed.
+
+        Chunks named by the manifest are loaded; everything else reverts
+        to pristine. Field fills are NOT restored from disk — the caller
+        re-registers them from the same deterministic init it built them
+        with (common-seed model init), and the manifest's recorded
+        shapes/dtypes are cross-checked against that registration so a
+        config drift (different model, different rho shape) fails loudly
+        instead of broadcasting the wrong fill under restored chunks.
+        """
+        root = os.path.abspath(os.path.join(directory, "client_store"))
+        path = _manifest_path(root, step)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no client-store manifest for step {step} under {root} "
+                "(the checkpoint was written without cohort mode, or the "
+                "store snapshot was deleted)"
+            )
+        with open(path) as f:
+            manifest = json.load(f)
+        if manifest.get("version") != _MANIFEST_VERSION:
+            raise ValueError(
+                f"client-store manifest version {manifest.get('version')} "
+                f"!= supported {_MANIFEST_VERSION}"
+            )
+        for key, mine in (
+            ("n_virtual", self.n_virtual),
+            ("chunk_clients", self.chunk_clients),
+        ):
+            if int(manifest[key]) != mine:
+                raise ValueError(
+                    f"client-store manifest {key}={manifest[key]} but this "
+                    f"run configured {mine}: the snapshot indexes a "
+                    "different virtual population and cannot be restored "
+                    "onto it"
+                )
+        for name, meta in manifest.get("fields", {}).items():
+            if name in self._fills:
+                row = self._fills[name]
+                if (
+                    list(row.shape) != list(meta["shape"])
+                    or str(row.dtype) != meta["dtype"]
+                ):
+                    raise ValueError(
+                        f"client-store field {name!r} was saved with "
+                        f"shape {meta['shape']} dtype {meta['dtype']} but "
+                        f"this run registered shape {list(row.shape)} "
+                        f"dtype {row.dtype}"
+                    )
+        self._chunks.clear()
+        self._dirty.clear()
+        self._files = {
+            int(c): fname for c, fname in manifest["chunks"].items()
+        }
+        self._seq = int(manifest.get("seq", 0))
+        self._saved_fields = dict(manifest.get("fields", {}))
+        for cid, fname in self._files.items():
+            with np.load(os.path.join(root, fname)) as z:
+                self._chunks[cid] = {k: z[k] for k in z.files}
+
+    # ------------------------------------------------------------- summary
+
+    def materialized_chunks(self) -> int:
+        return len(self._chunks)
+
+    def summary(self) -> dict:
+        """Small host-memory/occupancy digest for the end-of-run log."""
+        rows = sum(
+            next(iter(c.values())).shape[0] if c else 0
+            for c in self._chunks.values()
+        )
+        nbytes = sum(
+            a.nbytes for c in self._chunks.values() for a in c.values()
+        )
+        return {
+            "n_virtual": self.n_virtual,
+            "chunk_clients": self.chunk_clients,
+            "chunks_total": -(-self.n_virtual // self.chunk_clients),
+            "chunks_materialized": len(self._chunks),
+            "rows_materialized": int(rows),
+            "host_bytes": int(nbytes),
+            "fields": list(self.fields),
+        }

@@ -33,6 +33,7 @@ from federated_pytorch_test_tpu.data.pipeline import (
     client_stats,
     make_federated,
     normalize,
+    virtual_shard_assignment,
 )
 
 __all__ = [
@@ -50,4 +51,5 @@ __all__ = [
     "make_federated",
     "normalize",
     "synthetic_cifar",
+    "virtual_shard_assignment",
 ]

@@ -48,6 +48,29 @@ def client_splits(n: int, k: int) -> Tuple[Tuple[int, int], ...]:
     return tuple((bounds[i], bounds[i + 1]) for i in range(k))
 
 
+def virtual_shard_assignment(
+    n_train: int, n_virtual: int, n_shards: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Virtual-client → data-shard mapping for cohort mode (docs/SCALE.md).
+
+    `(shard_ids [N] int64, sample_counts [N] int64)`: virtual client v
+    holds shard `v mod n_shards`, and its sample count is the TRUE
+    `client_splits` range length of that shard (before
+    `make_federated`'s rectangular truncation) — the honest
+    weighted-cohort-sampling weight. THE one definition of the
+    assignment: the client store records it and the trainer gathers
+    cohort data through it; a drifted copy would pair a client's
+    sampler weight with another client's data.
+    """
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    shard_ids = np.arange(n_virtual, dtype=np.int64) % n_shards
+    split_sizes = np.asarray(
+        [e - s for s, e in client_splits(n_train, n_shards)], np.int64
+    )
+    return shard_ids, split_sizes[shard_ids]
+
+
 def client_stats(k: int, biased: bool) -> Tuple[np.ndarray, np.ndarray]:
     """Per-client normalization constants, shaped [K] (scalar per client)."""
     if biased:

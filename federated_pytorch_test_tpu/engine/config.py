@@ -64,6 +64,48 @@ class ExperimentConfig:
     batch: int = 512  # reference `default_batch`
     strategy: str = "fedavg"  # none | fedavg | admm
 
+    # --- cross-device scale: virtual clients + cohort sampling ---
+    # (clients/, docs/SCALE.md). With `virtual_clients=N` the experiment
+    # models a population of N virtual clients whose state lives in a
+    # host-side chunked store (clients/store.py); each outer loop a
+    # seeded, replayable cohort of `cohort` clients (clients/cohort.py —
+    # pure in (cohort_seed, nloop), like a FaultPlan) is GATHERED into
+    # the unchanged one-dispatch round program, trains every partition
+    # round of that loop, and is SCATTERED back. The compiled programs'
+    # client axis is then the cohort: `n_clients` is DERIVED (forced to
+    # `cohort`) in this mode, and the cohort axis shards across the mesh
+    # exactly as the static-K axis did (parallel/shardmap.py — per-device
+    # work is cohort/D, constant in N). Fault schedules stay keyed by
+    # VIRTUAL client id, so a client's chaos identity follows it across
+    # cohorts (docs/FAULT.md). N=K with cohort=K and
+    # cohort_weighting='identity' reproduces the legacy trajectory
+    # bitwise (tests/test_clients.py). None = legacy cross-silo mode.
+    virtual_clients: int | None = None
+    # cohort size C: virtual clients gathered per outer loop (required
+    # with virtual_clients; becomes the compiled client-axis width)
+    cohort: int | None = None
+    # cohort sampler seed — folded through the shared SEED_FOLDS
+    # registry (fault/plan.py), so even cohort_seed == fault-plan seed
+    # draws independent schedules
+    cohort_seed: int = 0
+    # 'uniform' | 'samples' (probability ∝ per-client sample count) |
+    # 'identity' (full participation; requires cohort == virtual_clients)
+    cohort_weighting: str = "uniform"
+    # how many disjoint data shards the virtual population maps onto
+    # (client v holds shard v % data_shards; the store records the
+    # assignment). None = one shard per virtual client — fine while
+    # n_train/N >= batch, set explicitly for N near or beyond the sample
+    # count (real cross-device fleets share far fewer distinct data
+    # distributions than devices).
+    data_shards: int | None = None
+    # virtual clients per store chunk (clients/store.py): the unit of
+    # lazy materialization and of the dirty-chunk checkpoint delta. One
+    # touched client materializes (and one dirtied chunk rewrites) a
+    # whole chunk — chunk_clients * n_params * 4 bytes — so the default
+    # stays small enough that a net-sized model's chunk is ~16 MB;
+    # raise it for tiny models where per-file overhead dominates.
+    store_chunk_clients: int = 64
+
     # loop nest sizes (reference src/federated_trio.py:20-22)
     nloop: int = 12  # outer loops over the partition groups
     nepoch: int = 1  # epochs per averaging round
@@ -326,6 +368,92 @@ class ExperimentConfig:
     max_groups: int | None = None
 
     def __post_init__(self):
+        # cohort-mode normalization FIRST: later checks (trimmed-mean
+        # sizing, mesh divisibility at Trainer init) must see the
+        # DERIVED n_clients — in cohort mode the compiled programs'
+        # client axis is the cohort, so n_clients is forced to it here
+        # (the one place the rule lives).
+        if self.virtual_clients is not None:
+            if self.virtual_clients < 1:
+                raise ValueError(
+                    f"virtual_clients must be >= 1, got {self.virtual_clients}"
+                )
+            if self.cohort is None:
+                raise ValueError(
+                    "virtual_clients requires a cohort size (--cohort C: "
+                    "how many virtual clients train per outer loop)"
+                )
+            if not 1 <= self.cohort <= self.virtual_clients:
+                raise ValueError(
+                    f"cohort must be in [1, virtual_clients="
+                    f"{self.virtual_clients}], got {self.cohort}"
+                )
+            if self.cohort_weighting not in ("uniform", "samples", "identity"):
+                raise ValueError(
+                    "cohort_weighting must be 'uniform', 'samples' or "
+                    f"'identity', got {self.cohort_weighting!r}"
+                )
+            if (
+                self.cohort_weighting == "identity"
+                and self.cohort != self.virtual_clients
+            ):
+                raise ValueError(
+                    "cohort_weighting='identity' is full participation: "
+                    f"cohort ({self.cohort}) must equal virtual_clients "
+                    f"({self.virtual_clients})"
+                )
+            if self.data_shards is not None and not (
+                1 <= self.data_shards <= self.virtual_clients
+            ):
+                raise ValueError(
+                    f"data_shards must be in [1, virtual_clients="
+                    f"{self.virtual_clients}], got {self.data_shards}"
+                )
+            if not self.init_model:
+                raise ValueError(
+                    "virtual clients require init_model=True: the store's "
+                    "pristine rows broadcast ONE common-seed init "
+                    "(clients/store.py), and per-client draws for N "
+                    "virtual clients would cost N model inits up front"
+                )
+            if self.hbm_data_budget_mb is not None:
+                raise ValueError(
+                    "cohort mode and host-streaming data are mutually "
+                    "exclusive: the streaming batchers hold per-client "
+                    "positions for a FIXED client set, but a cohort's "
+                    "membership changes every loop (the cohort data "
+                    "gather already keeps only C shards device-resident)"
+                )
+            object.__setattr__(self, "n_clients", int(self.cohort))
+        else:
+            # every cohort knob set away from its default without
+            # virtual_clients is a config mistake, not a no-op: a user
+            # who asked for weighted sampling must not silently get the
+            # legacy full-participation engine
+            if self.cohort is not None or self.data_shards is not None:
+                bad = "cohort" if self.cohort is not None else "data_shards"
+                raise ValueError(
+                    f"{bad} requires virtual_clients (cohort sampling "
+                    "only exists over a virtual-client population)"
+                )
+            chunk_default = type(self).__dataclass_fields__[
+                "store_chunk_clients"
+            ].default
+            if (
+                self.cohort_weighting != "uniform"
+                or self.cohort_seed != 0
+                or self.store_chunk_clients != chunk_default
+            ):
+                raise ValueError(
+                    "cohort_weighting/cohort_seed/store_chunk_clients "
+                    "require virtual_clients (cohort sampling only exists "
+                    "over a virtual-client population)"
+                )
+        if self.store_chunk_clients < 1:
+            raise ValueError(
+                f"store_chunk_clients must be >= 1, "
+                f"got {self.store_chunk_clients}"
+            )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"compute_dtype must be 'float32' or 'bfloat16', "

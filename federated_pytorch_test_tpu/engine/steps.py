@@ -7,6 +7,19 @@ closures and steps three optimizers sequentially in one process
 
 * `shard_map` over the `clients` mesh axis — each device holds a local
   block of K/D clients (their params, optimizer state, data shard);
+
+The builders are SHAPE-polymorphic in the client axis: nothing here
+knows whether the `[K]`-leading arrays are the legacy static population
+(every configured client, resident on device for the whole run) or a
+GATHERED `[C]` cohort of virtual clients (clients/, docs/SCALE.md — the
+trainer gathers C of N ≫ C host-stored clients per outer loop, runs the
+identical programs with the cohort as the client axis, and scatters the
+survivors back). Either way the axis shards across the mesh devices, so
+per-device work is (cohort or K)/D — constant in the virtual-population
+size N. Participation masks, corruption rows, and step budgets arrive as
+slot-indexed inputs; in cohort mode the trainer projects them from
+virtual-client-keyed schedules before the dispatch (fault identity
+follows the virtual id, not the slot).
 * `vmap` over the local block — every client's L-BFGS step (line-search
   probes included) is batched into single XLA ops;
 * `lax.scan` over the epoch's minibatches — the per-step index gather

@@ -16,6 +16,14 @@ sweep is enqueued at its cadence point and the blocking host fetch is
 deferred to the round boundary (`evaluate_deferred`,
 utils/metrics.py Deferred), so no eval stalls the device queue between
 rounds.
+
+With `--virtual-clients N --cohort C` (clients/, docs/SCALE.md) the
+loop nest grows one outer stage: each `Nloop` iteration GATHERS a
+seeded, replayable cohort of C virtual clients out of a host-side
+chunked store into exactly these programs (the client axis is then the
+cohort, sharded over the mesh as ever), runs the loop's partition
+rounds unchanged — still one dispatch per round — and SCATTERS the
+survivors' state back before the loop's stream marker and checkpoint.
 """
 
 from __future__ import annotations
@@ -31,7 +39,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from federated_pytorch_test_tpu.data import load_cifar, make_federated
+from federated_pytorch_test_tpu.clients import ClientStore, CohortSampler
+from federated_pytorch_test_tpu.data import (
+    client_stats,
+    load_cifar,
+    make_federated,
+    virtual_shard_assignment,
+)
 from federated_pytorch_test_tpu.engine.config import ExperimentConfig
 from federated_pytorch_test_tpu.engine.steps import (
     GroupContext,
@@ -122,7 +136,21 @@ class Trainer:
                 synthetic_n_train=cfg.synthetic_n_train,
                 synthetic_n_test=cfg.synthetic_n_test,
             )
-        self.fed = make_federated(source, cfg.n_clients, biased=cfg.biased_input)
+        # cross-device cohort mode (clients/, docs/SCALE.md): the data is
+        # split into `data_shards` disjoint shards (default one per
+        # virtual client) and virtual client v holds shard v mod shards;
+        # the compiled programs' client axis is the COHORT (config
+        # normalization forces n_clients == cohort), so `self.fed` here
+        # is the shard POOL — only the sampled cohort's shards are ever
+        # device-resident (gathered per outer loop, _begin_loop_cohort)
+        self._cohort_mode = cfg.virtual_clients is not None
+        self._cohort_ids = None
+        n_shards = (
+            (cfg.data_shards or cfg.virtual_clients)
+            if self._cohort_mode
+            else cfg.n_clients
+        )
+        self.fed = make_federated(source, n_shards, biased=cfg.biased_input)
         if self.fed.steps_per_epoch(cfg.batch) == 0:
             raise ValueError(
                 f"batch={cfg.batch} exceeds the per-client shard size "
@@ -165,6 +193,47 @@ class Trainer:
         flat = jax.vmap(lambda p: flatten_params(p)[0])(variables["params"])
         self.has_stats = "batch_stats" in variables
         stats = variables.get("batch_stats", {})
+
+        # virtual-client store + cohort sampler (clients/). The store's
+        # pristine rows broadcast the common-seed init (config requires
+        # init_model in cohort mode), so N never costs N inits or N rows
+        # of host memory — only touched chunks materialize. Fields:
+        # "flat", one per batch-stats leaf, and per-group "rho/<gid>"
+        # registered lazily at each group's first scatter. Stats leaves
+        # are addressed by tree path in canonical flatten order, the same
+        # order `jax.tree.leaves(self.stats)` yields at scatter time.
+        self.store = None
+        self.sampler = None
+        if self._cohort_mode:
+            n_v = cfg.virtual_clients
+            # THE shard assignment + honest per-client sample counts
+            # (data/pipeline.py virtual_shard_assignment)
+            shard_ids, sample_counts = virtual_shard_assignment(
+                source.train_images.shape[0], n_v, n_shards
+            )
+            self.store = ClientStore(
+                n_v, shard_ids, sample_counts,
+                chunk_clients=cfg.store_chunk_clients,
+            )
+            self.store.register_field("flat", np.asarray(flat0))
+            stats_leaves, self._stats_def = jax.tree_util.tree_flatten(stats)
+            stats_paths = jax.tree_util.tree_flatten_with_path(stats)[0]
+            self._stats_fields = []
+            for (path, leaf) in stats_paths:
+                name = "stats/" + jax.tree_util.keystr(path)
+                self._stats_fields.append(name)
+                self.store.register_field(name, np.asarray(leaf[0]))
+            self.sampler = CohortSampler(
+                n_v,
+                cfg.cohort,
+                seed=cfg.cohort_seed,
+                weighting=cfg.cohort_weighting,
+                sample_counts=self.store.sample_counts,
+            )
+            # normalization stats are a property of the VIRTUAL client
+            # (they follow it into whatever cohort slot it lands in);
+            # cycled exactly like the legacy per-client stats
+            self._vmean, self._vstd = client_stats(n_v, cfg.biased_input)
 
         # transformer-family checkpoints carry the fused-qkv column-order
         # version: the layout changed between rounds (head-major v2,
@@ -273,11 +342,23 @@ class Trainer:
                 )
                 for c in self._stream_clients
             }
+        elif self._cohort_mode:
+            # only the sampled cohort's shards ever reach the device:
+            # _begin_loop_cohort gathers [C]-leading slices per outer
+            # loop (the data half of the gather → round → scatter cycle)
+            self.shard_imgs = None
+            self.shard_labels = None
         else:
             self.shard_imgs = _put(self.fed.train_images, csh)
             self.shard_labels = _put(self.fed.train_labels, csh)
-        self.mean = _put(self.fed.mean, csh)
-        self.std = _put(self.fed.std, csh)
+        if self._cohort_mode:
+            # placeholder until the first gather: run() replaces these
+            # with the cohort's per-virtual-client stats each loop
+            self.mean = _put(self._vmean[: cfg.n_clients], csh)
+            self.std = _put(self._vstd[: cfg.n_clients], csh)
+        else:
+            self.mean = _put(self.fed.mean, csh)
+            self.std = _put(self.fed.std, csh)
         # the padded test sweep is staged as device-resident COMMITTED
         # arrays exactly once, here: every eval — standalone program or
         # folded into the fused round — reuses these buffers with zero
@@ -317,7 +398,12 @@ class Trainer:
         if cfg.fault_plan:
             self.injector = FaultInjector(
                 FaultPlan.parse(cfg.fault_plan),
-                cfg.n_clients,
+                # cohort mode keys every schedule by VIRTUAL client id:
+                # the plan draws [N] rows and the trainer gathers the
+                # cohort's columns (_vslice), so a client's fault
+                # identity — dropped, slow, Byzantine — follows it across
+                # cohorts instead of being a property of its slot
+                cfg.virtual_clients if self._cohort_mode else cfg.n_clients,
                 # crash sentinels live with the checkpoints they recover
                 # from; without checkpointing the record is process-local
                 state_dir=cfg.checkpoint_dir if cfg.save_model else None,
@@ -391,7 +477,13 @@ class Trainer:
                     )
                     for a in range(cfg.nadmm):
                         m = (
-                            self.injector.mask(nloop, gid, a)
+                            # cohort mode: the historical loop's cohort
+                            # is re-derived purely (sampler is a pure
+                            # function of (seed, nloop)) and the [N]
+                            # mask sliced to its transmitting members
+                            self._vslice(
+                                self.injector.mask(nloop, gid, a), nloop
+                            )
                             if self.injector is not None
                             else np.ones(cfg.n_clients, np.float32)
                         )
@@ -609,7 +701,11 @@ class Trainer:
         cfg = self.cfg
         total = self._round_total_steps()
         if self.injector is not None:
-            speeds = self.injector.speeds_for_round(nloop, gid, cfg.nadmm)
+            # [nadmm, N] in cohort mode (virtual-id-keyed speed axis),
+            # sliced to the loop's cohort columns
+            speeds = self._vslice(
+                self.injector.speeds_for_round(nloop, gid, cfg.nadmm), nloop
+            )
             step_t = self.injector.plan.step_time_s
         else:
             speeds = np.ones((cfg.nadmm, cfg.n_clients), np.float32)
@@ -654,6 +750,118 @@ class Trainer:
                 self.recorder.deadline_miss(
                     missed, nloop=nloop, group=gid, nadmm=a
                 )
+
+    # ------------------------------------------------- virtual clients
+    # (clients/, docs/SCALE.md): the gather -> rounds -> scatter cycle of
+    # one outer loop, plus the virtual-id -> cohort-slot projection every
+    # fault schedule rides.
+
+    def _vslice(self, arr: np.ndarray, nloop: int):
+        """Project a virtual-client-keyed last axis onto loop `nloop`'s
+        cohort slots (identity in legacy mode).
+
+        Fault schedules are drawn over the FULL virtual population
+        ([..., N] rows, keyed by virtual id) and the compiled round
+        program consumes cohort-slot rows ([..., C]); the projection is
+        pure — the sampler re-derives any loop's cohort from (seed,
+        nloop) — so resumed, fused, and unfused runs all slice the
+        identical columns.
+        """
+        if not self._cohort_mode:
+            return arr
+        return np.asarray(arr)[..., self.sampler.cohort(nloop)]
+
+    def _rho_gids(self) -> list:
+        """Partition groups with a persistent per-virtual-client rho
+        field in the store (registered at the group's first scatter)."""
+        return [
+            int(name.split("/", 1)[1])
+            for name in self.store.fields
+            if name.startswith("rho/")
+        ]
+
+    def _begin_loop_cohort(self, nloop: int) -> None:
+        """Gather loop `nloop`'s cohort out of the virtual-client store.
+
+        Everything slot-indexed that the round programs consume is
+        assembled here, per outer loop: params (`flat`), batch stats,
+        each group's persistent ADMM rho (pristine clients get the init
+        row — exactly what `build_round_init_fn` would produce), the
+        cohort members' data shards, and their per-virtual-client
+        normalization stats. `_owned_copy` for the donated carries, as
+        everywhere host arrays feed donating programs (module header).
+        """
+        ids = self.sampler.cohort(nloop)
+        self._cohort_ids = ids
+        csh = client_sharding(self.mesh)
+        with self.recorder.phase("cohort_gather", record=False, nloop=nloop):
+            self.flat = _owned_copy(
+                self._put(self.store.gather("flat", ids), csh)
+            )
+            leaves = [
+                _owned_copy(self._put(self.store.gather(name, ids), csh))
+                for name in self._stats_fields
+            ]
+            self.stats = jax.tree_util.tree_unflatten(self._stats_def, leaves)
+            self._rho_store = {
+                gid: _owned_copy(
+                    self._put(self.store.gather(f"rho/{gid}", ids), csh)
+                )
+                for gid in self._rho_gids()
+            }
+            shards = self.store.shard_ids[ids]
+            self.shard_imgs = self._put(self.fed.train_images[shards], csh)
+            self.shard_labels = self._put(self.fed.train_labels[shards], csh)
+            self.mean = self._put(self._vmean[ids], csh)
+            self.std = self._put(self._vstd[ids], csh)
+        # the membership record: slot s of this loop's series holds
+        # virtual client ids[s] — the slot->virtual-id key every other
+        # per-client series of the loop is read against
+        self.recorder.cohort(ids, nloop=nloop)
+
+    def _end_loop_cohort(self, nloop: int) -> None:
+        """Scatter the cohort's updated state back into the store.
+
+        The device->host copies are ENQUEUED asynchronously first (the
+        rounds' dispatches are still draining when this runs, and
+        `copy_to_host_async` overlaps the transfer with both the tail of
+        that compute and the host-side bookkeeping here) and finalized
+        by the blocking `_fetch`es below — which must complete before
+        `commit_loop`'s stream marker and the checkpoint, so a crash
+        never leaves the store behind the stream. Scatter must also
+        complete before the NEXT loop's gather: consecutive cohorts may
+        overlap, and a gather overtaking the scatter would hand the
+        shared member stale rows.
+        """
+        ids = self._cohort_ids
+        stats_leaves = jax.tree.leaves(self.stats)
+        for arr in (self.flat, *stats_leaves, *self._rho_store.values()):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax array (tests may inject numpy state)
+        with self.recorder.phase("cohort_scatter", record=False, nloop=nloop):
+            self.store.scatter("flat", ids, self._fetch(self.flat))
+            for name, leaf in zip(self._stats_fields, stats_leaves):
+                self.store.scatter(name, ids, self._fetch(leaf))
+            for gid, rho in sorted(self._rho_store.items()):
+                rho_np = self._fetch(rho)
+                name = f"rho/{gid}"
+                if not self.store.has_field(name):
+                    # pristine clients of later cohorts must gather the
+                    # INIT rho — exactly admm_init's full(rho0) row
+                    # (consensus/admm.py), so a client's first-ever round
+                    # in any cohort starts from the same rho a legacy run
+                    # would give it
+                    self.store.register_field(
+                        name,
+                        np.full(
+                            rho_np.shape[1:],
+                            self.cfg.admm_rho0,
+                            rho_np.dtype,
+                        ),
+                    )
+                self.store.scatter(name, ids, rho_np)
 
     def _fns(self, gid: int):
         if gid not in self._epoch_fns:
@@ -1117,6 +1325,12 @@ class Trainer:
                 "compile_round seeds the resident epoch program; streaming "
                 "epochs compile per-chunk shapes at first use instead"
             )
+        if self._cohort_mode and self.shard_imgs is None:
+            raise NotImplementedError(
+                "compile_round in cohort mode needs a gathered cohort "
+                "(the data arguments are per-loop slices); run() gathers "
+                "one before its first round"
+            )
         with self.recorder.phase("compile", record=False, group=gid):
             ctx_corrupt = self._corruption_enabled()
             if self._fused_enabled():
@@ -1475,7 +1689,9 @@ class Trainer:
             if consensus_fn is not None:
                 m_np = np.ones(cfg.n_clients, np.float32)
                 if self.injector is not None:
-                    m_np = self.injector.mask(nloop, gid, nadmm)
+                    m_np = self._vslice(
+                        self.injector.mask(nloop, gid, nadmm), nloop
+                    )
                     delay = self.injector.straggler_delay(nloop, gid, nadmm)
                     if delay > 0:
                         if cfg.round_deadline is not None:
@@ -1524,8 +1740,11 @@ class Trainer:
                 )
                 corr_args = ()
                 if corrupt:
-                    cm, cs, csd = self.injector.plan.corruption(
-                        cfg.n_clients, nloop, gid, nadmm
+                    cm, cs, csd = (
+                        self._vslice(row, nloop)
+                        for row in self.injector.plan.corruption(
+                            self.injector.n_clients, nloop, gid, nadmm
+                        )
                     )
                     csh = client_sharding(self.mesh)
                     corr_args = (
@@ -1650,7 +1869,9 @@ class Trainer:
         # so independent (strategy 'none') chaos runs must not stall or
         # record them here either
         if self.injector is not None and cfg.strategy != "none":
-            masks_np = self.injector.masks_for_round(nloop, gid, cfg.nadmm)
+            masks_np = self._vslice(
+                self.injector.masks_for_round(nloop, gid, cfg.nadmm), nloop
+            )
             for a, d in enumerate(
                 self.injector.straggler_delays_for_round(nloop, gid, cfg.nadmm)
             ):
@@ -1704,7 +1925,7 @@ class Trainer:
         if corrupt:
             sh = NamedSharding(self.mesh, PartitionSpec(None, CLIENT_AXIS))
             corr_args = tuple(
-                self._put(arr, sh)
+                self._put(self._vslice(arr, nloop), sh)
                 for arr in self.injector.corruption_for_round(
                     nloop, gid, cfg.nadmm
                 )
@@ -1835,6 +2056,28 @@ class Trainer:
         if rollback:
             self._maybe_rollback(snap, nloop, gid)
 
+    def run_loop(self, nloop: int) -> None:
+        """ONE outer loop: cohort gather (cohort mode) → every partition
+        group's round → cohort scatter.
+
+        The public per-loop entry point — `run()`'s loop body minus the
+        commit/checkpoint boundary, and the unit the cohort benchmarks
+        time (bench.py `_cohort_probe`,
+        benchmarks/client_scaling_tpu.py `_cohort_sweep`): one warm call
+        is exactly one gather→rounds→scatter cycle. The scatter runs
+        BEFORE the caller's stream marker and checkpoint: everything a
+        committed loop claims durable includes the store rows it wrote
+        (an injected crash inside `run_round` skips the scatter, leaving
+        the store at the previous loop — exactly what that loop's
+        checkpoint describes).
+        """
+        if self._cohort_mode:
+            self._begin_loop_cohort(nloop)
+        for gid in self.group_order:
+            self.run_round(nloop, gid)
+        if self._cohort_mode:
+            self._end_loop_cohort(nloop)
+
     def run(self) -> MetricsRecorder:
         """The full experiment (all Nloop outer loops).
 
@@ -1873,8 +2116,7 @@ class Trainer:
     def _run_impl(self) -> MetricsRecorder:
         cfg = self.cfg
         for nloop in range(self._completed_nloops, cfg.nloop):
-            for gid in self.group_order:
-                self.run_round(nloop, gid)
+            self.run_loop(nloop)
             self._completed_nloops = nloop + 1
             # stream durability barrier, BEFORE the checkpoint write: a
             # crash between the two leaves the stream AHEAD of the
@@ -1910,6 +2152,13 @@ class Trainer:
                         if self._ragged_enabled()
                         else None
                     ),
+                    # cohort mode: only faults scheduled onto SAMPLED
+                    # clients were injected (an unsampled client's
+                    # dropout never happened); the sampler's purity
+                    # keeps the totals resume-proof
+                    cohort=(
+                        self.sampler.cohort if self._cohort_mode else None
+                    ),
                 )
                 if self.injector is not None
                 else {"drops": 0, "stragglers": 0, "crashes": 0,
@@ -1927,6 +2176,29 @@ class Trainer:
         # end-of-run communication summary: partial-parameter exchange vs
         # the hypothetical full-model exchange vs the ship-the-data floor
         self.recorder.log("comm_summary", self._comm.summary())
+        if self._cohort_mode:
+            # per-virtual-client participation digest — pure in
+            # (cohort_seed, nloop), so a crashed-and-resumed run records
+            # the same totals as its uninterrupted twin
+            counts = self.sampler.participation_counts(cfg.nloop)
+            self.recorder.log(
+                "cohort_participation",
+                {
+                    "n_virtual": int(cfg.virtual_clients),
+                    "cohort": int(cfg.cohort),
+                    "loops": int(cfg.nloop),
+                    "sampled_ever": int((counts > 0).sum()),
+                    "min": int(counts.min()),
+                    "max": int(counts.max()),
+                    "mean": round(float(counts.mean()), 6),
+                },
+            )
+            # store occupancy is a fact about THIS process' host memory
+            # (a resumed run re-materializes only what its manifests
+            # name), so it stays out of the stream
+            self.recorder.log(
+                "store_summary", self.store.summary(), stream=False
+            )
         return self.recorder
 
     # ----------------------------------------------------------- checkpoint
@@ -1970,6 +2242,13 @@ class Trainer:
                 np.int64,
             )
         path = checkpoint_path(self.cfg.checkpoint_dir, step)
+        if self._cohort_mode and jax.process_index() == 0:
+            # dirty-chunk store snapshot BEFORE the orbax commit (same
+            # single-writer discipline): a crash between the two leaves a
+            # dangling manifest no checkpoint names — resume falls back
+            # to the previous (checkpoint, manifest) pair, both intact
+            # because chunk files are versioned, never overwritten
+            self.store.save(self.cfg.checkpoint_dir, step)
         if jax.process_count() > 1:
             # single-writer: `state` is byte-identical on every process
             # (_fetch allgathers), and save_checkpoint's host-side staging
@@ -2008,6 +2287,27 @@ class Trainer:
                 )
         for g, r in state.get("rho_store", {}).items():
             self._rho_store[int(g)] = _owned_copy(self._put(r, csh))
+        if self._cohort_mode:
+            # the store snapshot committed WITH this checkpoint (its
+            # manifest step is the restored loop cursor — Trainer.save
+            # writes both under the same step). Lazily-registered rho
+            # fields the crashed run had scattered are re-registered from
+            # the manifest's recorded shapes with the init-rho fill, so
+            # restored chunks stay addressable before the group's first
+            # round of the resumed run.
+            self.store.load(
+                self.cfg.checkpoint_dir, step=self._completed_nloops
+            )
+            for name, meta in self.store.saved_fields.items():
+                if name.startswith("rho/") and not self.store.has_field(name):
+                    self.store.register_field(
+                        name,
+                        np.full(
+                            [int(s) for s in meta["shape"]],
+                            self.cfg.admm_rho0,
+                            np.dtype(meta["dtype"]),
+                        ),
+                    )
         if not self._stream and "stream_positions" in state:
             # the mirror-image mismatch: a streaming checkpoint resumed
             # resident would silently continue under the reseeded

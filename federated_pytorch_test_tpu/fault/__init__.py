@@ -23,16 +23,20 @@ from federated_pytorch_test_tpu.fault.injector import (
 )
 from federated_pytorch_test_tpu.fault.plan import (
     CORRUPT_MODES,
+    SEED_FOLDS,
     CrashPoint,
     FaultPlan,
     InjectedCrash,
+    fold_seed,
 )
 
 __all__ = [
     "CORRUPT_MODES",
+    "SEED_FOLDS",
     "CrashPoint",
     "FaultInjector",
     "FaultPlan",
     "InjectedCrash",
+    "fold_seed",
     "step_budgets",
 ]

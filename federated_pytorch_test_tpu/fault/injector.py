@@ -194,6 +194,7 @@ class FaultInjector:
         exchanges: bool = True,
         total_steps: int | None = None,
         deadline_s: float | None = None,
+        cohort=None,
     ) -> dict:
         """Fault counts over the experiment's full round schedule.
 
@@ -214,20 +215,31 @@ class FaultInjector:
         deadline capped (the host serves `min(delay, deadline)` —
         engine/trainer.py). Both are pure in the plan + deadline, so a
         resumed run prints the same totals.
+
+        Cohort mode (clients/): `cohort` is the sampler's pure
+        `nloop -> [C] virtual ids` schedule — only faults landing on a
+        loop's SAMPLED clients count (an unsampled client's scheduled
+        dropout was never injected into any exchange). The sampler's
+        purity keeps the totals resume-proof exactly like the plan's.
         """
         drops = stragglers = crashes = corruptions = 0
         deadline_misses = capped_stalls = 0
         for nloop in range(nloops):
+            ids = cohort(nloop) if cohort is not None else None
             for gid in group_order:
                 for a in range(nadmm):
                     if exchanges:
                         mask = self.plan.participation(
                             self.n_clients, nloop, gid, a
                         )
-                        drops += int(self.n_clients - mask.sum())
+                        if ids is not None:
+                            mask = mask[ids]
+                        drops += int(mask.size - mask.sum())
                         modes, _, _ = self.plan.corruption(
                             self.n_clients, nloop, gid, a
                         )
+                        if ids is not None:
+                            modes = modes[ids]
                         corruptions += int((modes != 0).sum())
                         delay = self.plan.straggler_delay(nloop, gid, a)
                         if delay > 0:
@@ -235,10 +247,13 @@ class FaultInjector:
                             if deadline_s is not None and delay > deadline_s:
                                 capped_stalls += 1
                         if deadline_s is not None and total_steps:
+                            speeds = self.plan.client_speeds(
+                                self.n_clients, nloop, gid, a
+                            )
+                            if ids is not None:
+                                speeds = speeds[ids]
                             budgets = step_budgets(
-                                self.plan.client_speeds(
-                                    self.n_clients, nloop, gid, a
-                                ),
+                                speeds,
                                 self.plan.step_time_s,
                                 total_steps,
                                 deadline_s,

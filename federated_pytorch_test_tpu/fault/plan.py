@@ -71,6 +71,30 @@ class InjectedCrash(RuntimeError):
 # 0 is reserved for "no corruption this round".
 CORRUPT_MODES = {"scale": 1, "signflip": 2, "nan_burst": 3, "gauss": 4}
 
+# THE seed-fold registry: every independently-seeded schedule axis folds
+# `base_seed + SEED_FOLDS[axis]` into its SeedSequence, so adding one
+# axis to a plan perturbs none of the others' draws. These offsets used
+# to be scattered magic numbers (+1 straggler, +2 corruption, +3 speed)
+# across this file; any new axis MUST claim its fold here — two axes
+# sharing an offset would draw correlated schedules silently (the
+# distinctness is regression-tested in tests/test_clients.py). "cohort"
+# is reserved for the virtual-client cohort sampler (clients/cohort.py),
+# which rides the same registry even though its base seed is the
+# separate `--cohort-seed`: an operator pointing both seeds at the same
+# value must still get independent cohort and dropout draws.
+SEED_FOLDS = {
+    "dropout": 0,
+    "straggler": 1,
+    "corruption": 2,
+    "speed": 3,
+    "cohort": 4,
+}
+
+
+def fold_seed(base: int, axis: str) -> int:
+    """`base` folded for one schedule axis (masked to SeedSequence range)."""
+    return (base + SEED_FOLDS[axis]) & 0x7FFFFFFF
+
 
 @dataclasses.dataclass(frozen=True)
 class CrashPoint:
@@ -190,7 +214,7 @@ class FaultPlan:
         # (engine/trainer.py _epoch_seed): deterministic in (seed, cursor),
         # independent across rounds
         return np.random.default_rng(
-            [self.seed & 0x7FFFFFFF, nloop, gid, nadmm]
+            [fold_seed(self.seed, "dropout"), nloop, gid, nadmm]
         )
 
     def participation(
@@ -212,10 +236,10 @@ class FaultPlan:
         """Host-side seconds this round's consensus stalls (0 = no straggler)."""
         if self.straggler_p <= 0.0 or self.straggler_delay_s <= 0.0:
             return 0.0
-        # a separate fold from participation so adding stragglers to a plan
-        # does not perturb its dropout masks
+        # a separate fold from participation (SEED_FOLDS) so adding
+        # stragglers to a plan does not perturb its dropout masks
         rng = np.random.default_rng(
-            [(self.seed + 1) & 0x7FFFFFFF, nloop, gid, nadmm]
+            [fold_seed(self.seed, "straggler"), nloop, gid, nadmm]
         )
         return self.straggler_delay_s if rng.random() < self.straggler_p else 0.0
 
@@ -228,7 +252,8 @@ class FaultPlan:
         `strengths [K]` float32, `seeds [K]` int32 (the per-client PRNG
         seed the `gauss` mode folds into its on-device noise draw).
         Pure in (seed, cursor) like the dropout masks — a separate seed
-        fold (+2), so adding corruption to a plan perturbs neither its
+        fold (SEED_FOLDS['corruption']), so adding corruption to a plan
+        perturbs neither its
         dropout masks nor its straggler schedule.
         """
         modes = np.zeros(n_clients, np.int32)
@@ -237,7 +262,7 @@ class FaultPlan:
         if not self.has_corruption:
             return modes, strengths, seeds
         rng = np.random.default_rng(
-            [(self.seed + 2) & 0x7FFFFFFF, nloop, gid, nadmm]
+            [fold_seed(self.seed, "corruption"), nloop, gid, nadmm]
         )
         if self.corrupt_k > 0:
             if self.corrupt_k > n_clients:
@@ -265,7 +290,8 @@ class FaultPlan:
 
         A slow client's inner step takes `slow_factor * step_time_s`
         simulated seconds instead of `step_time_s`. Pure in (seed,
-        cursor) like every other axis — a separate seed fold (+3), so
+        cursor) like every other axis — a separate seed fold
+        (SEED_FOLDS['speed']), so
         adding heterogeneity to a plan perturbs none of its dropout
         masks, straggler schedule, or corruption draws.
         """
@@ -273,7 +299,7 @@ class FaultPlan:
         if not self.has_heterogeneity:
             return speeds
         rng = np.random.default_rng(
-            [(self.seed + 3) & 0x7FFFFFFF, nloop, gid, nadmm]
+            [fold_seed(self.seed, "speed"), nloop, gid, nadmm]
         )
         if self.slow_k > 0:
             if self.slow_k > n_clients:

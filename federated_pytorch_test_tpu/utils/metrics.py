@@ -452,6 +452,23 @@ class MetricsRecorder:
                 f"nadmm={nadmm}"
             )
 
+    def cohort(self, ids, *, nloop) -> None:
+        """The virtual-client cohort gathered for one outer loop (`[C]`
+        ascending virtual ids — clients/cohort.py).
+
+        Slot `s` of every other per-client series of the loop
+        (train_loss columns, update_norm, step_budget, fault/quarantine
+        client lists) refers to virtual client `ids[s]`: this record is
+        the slot→virtual-id key. Only recorded in cohort mode, so
+        legacy-mode streams stay byte-identical (and the identity-
+        sampling bitwise bridge compares trajectories, not this series).
+        """
+        vals = [int(i) for i in ids]
+        self.log("cohort", {"clients": vals}, nloop=nloop)
+        if self.verbose:
+            ids_s = ",".join(str(v) for v in vals)
+            print(f"cohort nloop={nloop} clients={ids_s}")
+
     def group_distance(self, dists, *, nloop, group) -> None:
         """Per-group distance-from-mean diagnostic (`[num_groups]`).
 

@@ -42,7 +42,14 @@
 #                                    + trimmed combiner + planned crash,
 #                                    recovered via rerun, crashed+resumed
 #                                    stream identical to the
-#                                    uninterrupted twin's)
+#                                    uninterrupted twin's) and
+#                                    cohort_smoke (10k virtual clients,
+#                                    C=8 cohorts, dropout+corruption
+#                                    keyed by virtual id, trimmed
+#                                    combiner, planned crash recovered
+#                                    via rerun — store manifest + stream
+#                                    + cohort sequence all splice, twin
+#                                    stream-identity asserted)
 #
 # Usage:
 #   scripts/ci.sh            # tier 1 then tier 2 (both tiers, full CI)
@@ -54,6 +61,35 @@
 # TPU is needed; the persistent compile cache amortizes repeat runs.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+assert_stream_identity() {
+  # THE twin-compare normalizer, shared by every smoke that proves
+  # crashed+resumed stream identity: records equal modulo wall-clock
+  # fields ("t", step_time seconds) and the header tag (the twins'
+  # plans legitimately differ by the crash point). $1/$2: the two JSONL
+  # streams; $3: extra python asserts evaluated with the normalized
+  # record list bound as `recs`.
+  python - "$1" "$2" "${3:-}" <<'PY'
+import json, sys
+
+def norm(path):
+    out = []
+    for line in open(path):
+        d = json.loads(line)
+        d.pop("t", None)
+        if d.get("event") == "stream_header":
+            d.pop("tag", None)
+        if d.get("series") == "step_time":
+            d["value"] = {k: v for k, v in d["value"].items() if k != "seconds"}
+        out.append(d)
+    return out
+
+a, b = norm(sys.argv[1]), norm(sys.argv[2])
+assert a == b, f"streams differ: {len(a)} vs {len(b)} records"
+if sys.argv[3]:
+    exec(sys.argv[3], {"recs": a})
+PY
+}
 
 chaos_smoke() {
   # End-to-end Byzantine chaos through the REAL CLI: one client per round
@@ -140,32 +176,72 @@ hetero_smoke() {
     echo "hetero smoke FAILED: partial updates tripped a rollback" >&2
     rm -rf "$d"; return 1
   fi
-  # stream identity: the crashed+resumed stream equals the twin's modulo
-  # wall-clock fields and the header tag (the plans differ by the crash)
-  python - "$d/run.jsonl" "$d/twin.jsonl" <<'PY' || {
-import json, sys
-
-def norm(path):
-    out = []
-    for line in open(path):
-        d = json.loads(line)
-        d.pop("t", None)
-        if d.get("event") == "stream_header":
-            d.pop("tag", None)
-        if d.get("series") == "step_time":
-            d["value"] = {k: v for k, v in d["value"].items() if k != "seconds"}
-        out.append(d)
-    return out
-
-a, b = norm(sys.argv[1]), norm(sys.argv[2])
-assert a == b, f"streams differ: {len(a)} vs {len(b)} records"
-assert any(d.get("series") == "deadline_miss" for d in a)
-assert any(d.get("series") == "client_time" for d in a)
-PY
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+assert any(d.get("series") == "deadline_miss" for d in recs)
+assert any(d.get("series") == "client_time" for d in recs)
+' || {
     echo "hetero smoke FAILED: crashed+resumed stream differs from twin" >&2
     rm -rf "$d"; return 1
   }
   echo "hetero smoke OK"
+  rm -rf "$d"
+}
+
+cohort_smoke() {
+  # End-to-end cross-device scale through the REAL CLI (clients/,
+  # docs/SCALE.md): 10k virtual clients mapped onto 8 data shards, a
+  # C=8 cohort per outer loop, a dropout+corruption plan keyed by
+  # VIRTUAL client id, the trimmed combiner, and a planned crash at
+  # (nloop=1, gid=2, nadmm=0) killing the first run after loop 0's
+  # store scatter + dirty-chunk checkpoint. Recovery is rerunning the
+  # IDENTICAL command (--resume auto restores the checkpoint AND the
+  # store manifest, and the pure cohort sampler re-derives every
+  # historical cohort); an uninterrupted twin (same plan minus the
+  # crash) then proves crashed+resumed stream identity — cohort
+  # membership records included. Small-N fast variants of the same
+  # contracts run in tier 1 (tests/test_clients.py).
+  local d; d="$(mktemp -d)"
+  local common=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 320 --synthetic-n-test 60 --batch 20
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --virtual-clients 10000 --cohort 8 --data-shards 8 --cohort-seed 11
+    --store-chunk-clients 8
+    --robust-agg trimmed --robust-f 1
+    --save-model --resume auto)
+  local cmd=("${common[@]}"
+    --fault-plan "seed=7,dropout=0.2,corrupt=0.05:scale:10,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${common[@]}"
+    --fault-plan "seed=7,dropout=0.2,corrupt=0.05:scale:10"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  echo "cohort smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "cohort smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "cohort smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "cohort smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "cohort smoke FAILED: the uninterrupted twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  grep -q '# cohort: 8 of 10000 virtual clients' "$d/run2.log" || {
+    echo "cohort smoke FAILED: missing/incorrect cohort summary line" >&2
+    grep '# cohort' "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+cohorts = [d for d in recs if d.get("series") == "cohort"]
+assert len(cohorts) == 2, cohorts
+assert all(len(d["value"]["clients"]) == 8 for d in cohorts)
+assert any(d.get("series") == "cohort_participation" for d in recs)
+' || {
+    echo "cohort smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "cohort smoke OK"
   rm -rf "$d"
 }
 
@@ -177,12 +253,14 @@ case "$tier" in
     python -m pytest tests/ -m slow -q "$@"
     chaos_smoke
     hetero_smoke
+    cohort_smoke
     ;;
   all)
     python -m pytest tests/ -m 'not slow' -q "$@"
     python -m pytest tests/ -m slow -q "$@"
     chaos_smoke
     hetero_smoke
+    cohort_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;
 esac

@@ -1,0 +1,497 @@
+"""Virtual-client store + cohort sampling contracts (clients/, docs/SCALE.md).
+
+The cross-device scale PR's gates, in the default tier:
+
+* **bitwise bridge** — N=K virtual clients with C=K identity sampling
+  reproduce the legacy every-client-every-round trajectory bit for bit
+  (params, rho store, every recorded series), fused here and unfused in
+  the slow tier, fedavg AND admm incl. BB-rho;
+* **one-dispatch budget** — a sampled-cohort round's dispatch count
+  stays exactly {round: 1, round_init: 1} (gather/scatter live outside
+  the program);
+* **replayability** — the sampler is pure in (seed, nloop), uniform
+  (chi-square), weighted sampling follows sample counts, and a
+  crashed+resumed cohort run's metric stream and store contents are
+  identical to an uninterrupted twin's (the tier-1 small-N fast variant
+  of scripts/ci.sh cohort_smoke);
+* **O(C) checkpoints** — a save's dirty-chunk delta scales with the
+  cohort, not the population;
+* **seed-fold registry** — all schedule axes (dropout, straggler,
+  corruption, speed, cohort) hold distinct folds.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.clients import ClientStore, CohortSampler
+from federated_pytorch_test_tpu.data import synthetic_cifar
+from federated_pytorch_test_tpu.engine import ExperimentConfig, Trainer, get_preset
+from federated_pytorch_test_tpu.fault import SEED_FOLDS, FaultPlan
+
+SRC = synthetic_cifar(n_train=240, n_test=60)
+
+SERIES = (
+    "train_loss", "dual_residual", "primal_residual", "mean_rho",
+    "test_accuracy",
+)
+
+
+def tiny(preset: str, **over) -> ExperimentConfig:
+    base = dict(
+        batch=40, nloop=2, max_groups=1, model="net",
+        check_results=True, eval_batch=30, synthetic_ok=True,
+    )
+    base.update(over)
+    return get_preset(preset, **base)
+
+
+def _run(cfg):
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    rec = tr.run()
+    return tr, rec
+
+
+# --------------------------------------------------------------- seed folds
+
+
+@pytest.mark.smoke
+def test_seed_folds_distinct():
+    # the registry's whole point: no two schedule axes may share a fold,
+    # or their draws would be correlated silently
+    folds = list(SEED_FOLDS.values())
+    assert len(folds) == len(set(folds)), SEED_FOLDS
+    assert set(SEED_FOLDS) >= {
+        "dropout", "straggler", "corruption", "speed", "cohort"
+    }
+
+
+@pytest.mark.smoke
+def test_registry_folds_match_legacy_offsets():
+    # the refactor moved magic numbers into SEED_FOLDS; the schedules
+    # existing plans produce must be unchanged (replayability across
+    # versions — a re-run chaos experiment must draw the same faults)
+    plan = FaultPlan(
+        seed=5, dropout_p=0.3, straggler_p=0.5, straggler_delay_s=1.0,
+        corrupt_p=0.2, slow_p=0.2,
+    )
+    rng = np.random.default_rng([5, 0, 1, 2])
+    np.testing.assert_array_equal(
+        plan.participation(8, 0, 1, 2), (rng.random(8) >= 0.3).astype(np.float32)
+    )
+    rng = np.random.default_rng([6, 0, 1, 2])
+    assert plan.straggler_delay(0, 1, 2) == (
+        1.0 if rng.random() < 0.5 else 0.0
+    )
+    rng = np.random.default_rng([7, 0, 1, 2])
+    modes, _, _ = plan.corruption(8, 0, 1, 2)
+    np.testing.assert_array_equal((modes != 0), rng.random(8) < 0.2)
+    rng = np.random.default_rng([8, 0, 1, 2])
+    speeds = plan.client_speeds(8, 0, 1, 2)
+    np.testing.assert_array_equal(speeds != 1.0, rng.random(8) < 0.2)
+
+
+# ------------------------------------------------------------------ sampler
+
+
+@pytest.mark.smoke
+def test_cohort_sampler_pure_sorted_replayable():
+    s1 = CohortSampler(100, 8, seed=3)
+    s2 = CohortSampler(100, 8, seed=3)
+    for nloop in (0, 1, 7, 1):  # out-of-order replay (resume) included
+        a, b = s1.cohort(nloop), s2.cohort(nloop)
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.int64 and np.all(np.diff(a) > 0)
+        assert a.min() >= 0 and a.max() < 100
+    assert not np.array_equal(s1.cohort(0), s1.cohort(1))
+    assert not np.array_equal(
+        CohortSampler(100, 8, seed=4).cohort(0), s1.cohort(0)
+    )
+
+
+@pytest.mark.smoke
+def test_cohort_sampler_distinct_from_dropout_draws():
+    # the reserved fold: cohort_seed == plan seed must still give
+    # independent draws (same base seed, different SEED_FOLDS offset)
+    plan = FaultPlan(seed=3, dropout_p=0.5)
+    s = CohortSampler(16, 16, seed=3)  # C=N: a permutation-free draw
+    # the sampler's rng stream differs from the dropout stream: compare
+    # the raw first draws of each fold
+    a = np.random.default_rng([3, 0]).random(16)
+    b = np.random.default_rng([3 + SEED_FOLDS["cohort"], 0]).random(16)
+    assert not np.allclose(a, b)
+    del plan, s  # constructed to prove the API composes
+
+
+@pytest.mark.smoke
+def test_cohort_sampler_uniform_chi_square():
+    n, c, loops = 20, 5, 400
+    s = CohortSampler(n, c, seed=1)
+    counts = s.participation_counts(loops)
+    assert counts.sum() == c * loops
+    expected = c * loops / n  # 100 per client
+    chi2 = float(((counts - expected) ** 2 / expected).sum())
+    # dof = 19; the 0.999 quantile is ~43.8 — a seeded draw far above it
+    # means the sampler is biased, not unlucky
+    assert chi2 < 43.8, (chi2, counts.tolist())
+
+
+@pytest.mark.smoke
+def test_cohort_sampler_weighted_by_samples():
+    n, c, loops = 10, 2, 600
+    counts = np.ones(n)
+    counts[0] = 50.0  # client 0 holds 50x the data
+    s = CohortSampler(n, c, seed=2, weighting="samples", sample_counts=counts)
+    picked = s.participation_counts(loops)
+    assert picked.sum() == c * loops
+    # client 0 must dominate; without-replacement caps it at once per loop
+    assert picked[0] > 0.8 * loops
+    assert picked[0] > 3 * picked[1:].max()
+
+
+@pytest.mark.smoke
+def test_cohort_sampler_validation():
+    with pytest.raises(ValueError, match="cohort"):
+        CohortSampler(4, 5)
+    with pytest.raises(ValueError, match="identity"):
+        CohortSampler(4, 2, weighting="identity")
+    with pytest.raises(ValueError, match="sample_counts"):
+        CohortSampler(4, 2, weighting="samples")
+    with pytest.raises(ValueError, match="positive"):
+        CohortSampler(
+            4, 2, weighting="samples", sample_counts=[1, 0, 1, 1]
+        )
+
+
+@pytest.mark.smoke
+def test_fault_identity_follows_virtual_id():
+    # the same virtual client sampled into two different cohorts carries
+    # the same per-round fault row: schedules are keyed by virtual id,
+    # and a cohort is only a projection of them
+    plan = FaultPlan(seed=9, dropout_p=0.4, corrupt_p=0.3)
+    full = plan.participation(50, 2, 1, 0)
+    modes, _, _ = plan.corruption(50, 2, 1, 0)
+    a = np.array([3, 17, 30])
+    b = np.array([17, 22, 41])
+    np.testing.assert_array_equal(full[a][1], full[b][0])  # client 17
+    np.testing.assert_array_equal(modes[a][1], modes[b][0])
+
+
+# -------------------------------------------------------------------- store
+
+
+@pytest.mark.smoke
+def test_store_pristine_gather_and_roundtrip():
+    st = ClientStore(40, np.arange(40) % 5, np.full(40, 7), chunk_clients=8)
+    st.register_field("flat", np.arange(3, dtype=np.float32))
+    g = st.gather("flat", np.array([0, 39]))
+    np.testing.assert_array_equal(g, np.tile(np.arange(3, dtype=np.float32), (2, 1)))
+    assert st.materialized_chunks() == 0  # gather never materializes
+    rows = np.stack([np.full(3, 5, np.float32), np.full(3, 6, np.float32)])
+    st.scatter("flat", np.array([1, 33]), rows)
+    np.testing.assert_array_equal(
+        st.gather("flat", np.array([33, 1, 2])),
+        np.stack([rows[1], rows[0], np.arange(3, dtype=np.float32)]),
+    )
+    assert st.materialized_chunks() == 2
+    with pytest.raises(IndexError):
+        st.gather("flat", np.array([40]))
+    with pytest.raises(ValueError, match="dtype"):
+        st.scatter("flat", np.array([0]), np.zeros((1, 3), np.float64))
+    with pytest.raises(ValueError, match="different fill"):
+        st.register_field("flat", np.zeros(3, np.float32))
+
+
+@pytest.mark.smoke
+def test_store_checkpoint_delta_is_o_cohort(tmp_path):
+    # N=1024 clients in 64 chunks; a C=8 cohort dirties <= 8 chunks, so
+    # each save writes <= 8 chunk files + 1 manifest — never O(N)
+    n, chunk, c = 1024, 16, 8
+    st = ClientStore(n, np.arange(n) % 4, np.full(n, 5), chunk_clients=chunk)
+    st.register_field("flat", np.zeros(4, np.float32))
+    d = str(tmp_path)
+    root = os.path.join(d, "client_store")
+    rng = np.random.default_rng(0)
+    seen = set()
+    for step in range(1, 4):
+        ids = np.sort(rng.choice(n, c, replace=False))
+        st.scatter(
+            "flat", ids,
+            np.full((c, 4), float(step), np.float32),
+        )
+        before = set(os.listdir(root)) if os.path.isdir(root) else set()
+        st.save(d, step)
+        new = set(os.listdir(root)) - before
+        new_chunks = {f for f in new if f.startswith("chunk_")}
+        assert len(new_chunks) <= len(st.touched_chunks(ids)) <= c, new
+        assert f"manifest_step_{step}.json" in new
+        seen |= {int(i) for i in ids}
+    # a fresh store restored from the last manifest sees every write
+    st2 = ClientStore(n, np.arange(n) % 4, np.full(n, 5), chunk_clients=chunk)
+    st2.register_field("flat", np.zeros(4, np.float32))
+    st2.load(d, 3)
+    all_ids = np.arange(n)
+    np.testing.assert_array_equal(
+        st2.gather("flat", all_ids), st.gather("flat", all_ids)
+    )
+    # population/chunking mismatches refuse to restore
+    st3 = ClientStore(n + 1, np.zeros(n + 1), np.ones(n + 1), chunk_clients=chunk)
+    st3.register_field("flat", np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="n_virtual"):
+        st3.load(d, 3)
+    # retention: only the newest keep_manifests (2) snapshots remain —
+    # older manifests pruned, superseded chunk versions GC'd, so disk
+    # stays O(touched population) + keep*O(C), not O(loops * C)
+    entries = set(os.listdir(root))
+    manifests = {e for e in entries if e.startswith("manifest_")}
+    assert manifests == {"manifest_step_2.json", "manifest_step_3.json"}
+    referenced = set()
+    for m in manifests:
+        referenced |= set(
+            json.load(open(os.path.join(root, m)))["chunks"].values()
+        )
+    assert {e for e in entries if e.startswith("chunk_")} == referenced
+
+
+@pytest.mark.smoke
+def test_store_manifest_commit_is_atomic(tmp_path):
+    # chunk files land before the manifest: a "crash" between the two
+    # (simulated by saving chunks then corrupting the new manifest)
+    # leaves the PREVIOUS manifest restorable
+    n, chunk = 32, 8
+    d = str(tmp_path)
+    st = ClientStore(n, np.zeros(n), np.ones(n), chunk_clients=chunk)
+    st.register_field("flat", np.zeros(2, np.float32))
+    st.scatter("flat", np.array([0]), np.ones((1, 2), np.float32))
+    st.save(d, 1)
+    st.scatter("flat", np.array([0]), np.full((1, 2), 9, np.float32))
+    st.save(d, 2)
+    os.remove(os.path.join(d, "client_store", "manifest_step_2.json"))
+    st2 = ClientStore(n, np.zeros(n), np.ones(n), chunk_clients=chunk)
+    st2.register_field("flat", np.zeros(2, np.float32))
+    with pytest.raises(FileNotFoundError):
+        st2.load(d, 2)
+    st2.load(d, 1)  # the previous snapshot is intact (versioned chunks)
+    np.testing.assert_array_equal(
+        st2.gather("flat", np.array([0]))[0], np.ones(2, np.float32)
+    )
+
+
+# ------------------------------------------------------------- config gates
+
+
+@pytest.mark.smoke
+def test_config_cohort_validation():
+    with pytest.raises(ValueError, match="cohort size"):
+        ExperimentConfig(virtual_clients=8)
+    with pytest.raises(ValueError, match="cohort must be"):
+        ExperimentConfig(virtual_clients=8, cohort=9)
+    with pytest.raises(ValueError, match="identity"):
+        ExperimentConfig(
+            virtual_clients=8, cohort=4, cohort_weighting="identity"
+        )
+    with pytest.raises(ValueError, match="virtual_clients"):
+        ExperimentConfig(cohort=4)
+    with pytest.raises(ValueError, match="init_model"):
+        ExperimentConfig(virtual_clients=8, cohort=4, init_model=False)
+    with pytest.raises(ValueError, match="streaming"):
+        ExperimentConfig(virtual_clients=8, cohort=4, hbm_data_budget_mb=1)
+    # n_clients is DERIVED in cohort mode: the program width is the cohort
+    cfg = ExperimentConfig(virtual_clients=8, cohort=4, n_clients=3)
+    assert cfg.n_clients == 4
+    # trimmed-mean sizing reads the derived width
+    with pytest.raises(ValueError, match="trimmed"):
+        ExperimentConfig(
+            virtual_clients=8, cohort=2, robust_agg="trimmed", robust_f=1
+        )
+
+
+# ---------------------------------------------------- engine-level contracts
+
+
+@pytest.mark.parametrize(
+    "preset,over",
+    [
+        # one loop: the gather-from-pristine-store path (cross-loop
+        # scatter->gather is covered by the admm leg and the crash test)
+        ("fedavg", dict(nadmm=2, nloop=1)),
+        # BB-rho crossing a due step inside the fused scan PLUS the rho
+        # store roundtripping through the virtual-client store each loop
+        ("admm", dict(nadmm=3, bb_update=True)),
+    ],
+)
+def test_identity_cohort_matches_legacy_bitwise(preset, over):
+    """THE bridge gate: N=K, C=K, identity sampling == legacy, bit for
+    bit — params, BB rho, and every recorded series (fused path; the
+    unfused leg runs in the slow tier)."""
+    tr_l, rec_l = _run(tiny(preset, **over))
+    tr_c, rec_c = _run(
+        tiny(
+            preset,
+            virtual_clients=3,
+            cohort=3,
+            cohort_weighting="identity",
+            **over,
+        )
+    )
+    np.testing.assert_array_equal(np.asarray(tr_l.flat), np.asarray(tr_c.flat))
+    assert sorted(tr_l._rho_store) == sorted(tr_c._rho_store)
+    for g in tr_l._rho_store:
+        np.testing.assert_array_equal(
+            np.asarray(tr_l._rho_store[g]), np.asarray(tr_c._rho_store[g])
+        )
+    for name in SERIES:
+        a = [r["value"] for r in rec_l.series.get(name, [])]
+        b = [r["value"] for r in rec_c.series.get(name, [])]
+        assert a == b, name
+    # and the store holds exactly the final device state
+    np.testing.assert_array_equal(
+        tr_c.store.gather("flat", np.arange(3)), np.asarray(tr_c.flat)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "preset,over",
+    [
+        ("fedavg", dict(nadmm=2)),
+        ("admm", dict(nadmm=3, bb_update=True)),
+    ],
+)
+def test_identity_cohort_matches_legacy_bitwise_unfused(preset, over):
+    tr_l, rec_l = _run(tiny(preset, fuse_rounds=False, **over))
+    tr_c, rec_c = _run(
+        tiny(
+            preset,
+            fuse_rounds=False,
+            virtual_clients=3,
+            cohort=3,
+            cohort_weighting="identity",
+            **over,
+        )
+    )
+    assert not tr_c._fused_enabled()
+    np.testing.assert_array_equal(np.asarray(tr_l.flat), np.asarray(tr_c.flat))
+    for name in SERIES:
+        a = [r["value"] for r in rec_l.series.get(name, [])]
+        b = [r["value"] for r in rec_c.series.get(name, [])]
+        assert a == b, name
+
+
+def test_sampled_cohort_round_is_one_dispatch():
+    """The dispatch-budget gate survives cohort mode: gather/scatter are
+    host-side, so every partition round of a sampled-cohort loop still
+    costs exactly {round: 1, round_init: 1}."""
+    cfg = tiny(
+        "fedavg",
+        nadmm=2,
+        virtual_clients=40,
+        cohort=4,
+        data_shards=4,
+        fault_plan="seed=5,dropout=0.3",
+    )
+    tr, rec = _run(cfg)
+    for r in rec.series["dispatch_count"]:
+        assert r["value"] == {"round": 1, "round_init": 1, "total": 2}, r
+    # membership recorded per loop, C ids each, all in range
+    cohorts = [r["value"]["clients"] for r in rec.series["cohort"]]
+    assert len(cohorts) == cfg.nloop
+    for ids in cohorts:
+        assert len(ids) == 4 and all(0 <= i < 40 for i in ids)
+    part = rec.latest("cohort_participation")
+    assert part["n_virtual"] == 40 and part["cohort"] == 4
+    assert part["sampled_ever"] >= 4
+
+
+def test_cohort_crash_resume_stream_and_store_identity(tmp_path):
+    """Tier-1 fast variant of scripts/ci.sh cohort_smoke: a planned
+    crash mid-run, recovered via rerun — the resumed stream equals the
+    uninterrupted twin's (cohort records included) and both stores hold
+    identical rows for the whole population."""
+    from federated_pytorch_test_tpu.fault import InjectedCrash
+
+    def cfg_for(tag, fault_plan):
+        return tiny(
+            "fedavg",
+            nloop=2,
+            nadmm=2,
+            virtual_clients=32,
+            cohort=4,
+            data_shards=4,
+            cohort_seed=9,
+            save_model=True,
+            resume="auto",
+            store_chunk_clients=8,
+            fault_plan=fault_plan,
+            checkpoint_dir=str(tmp_path / f"ckpt_{tag}"),
+            metrics_stream=str(tmp_path / f"{tag}.jsonl"),
+        )
+
+    cfg = cfg_for("run", "seed=5,dropout=0.3,crash=1:2:0")
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    with pytest.raises(InjectedCrash):
+        tr.run()
+    tr2 = Trainer(cfg, verbose=False, source=SRC)
+    tr2.run()
+    twin = Trainer(
+        cfg_for("twin", "seed=5,dropout=0.3"), verbose=False, source=SRC
+    )
+    twin.run()
+
+    def norm(path):
+        out = []
+        for line in open(path):
+            d = json.loads(line)
+            d.pop("t", None)
+            if d.get("event") == "stream_header":
+                d.pop("tag", None)  # plans differ by the crash point
+            if d.get("series") == "step_time":
+                d["value"] = {
+                    k: v for k, v in d["value"].items() if k != "seconds"
+                }
+            out.append(d)
+        return out
+
+    a = norm(str(tmp_path / "run.jsonl"))
+    b = norm(str(tmp_path / "twin.jsonl"))
+    assert a == b, f"streams differ: {len(a)} vs {len(b)} records"
+    cohorts = [d for d in a if d.get("series") == "cohort"]
+    assert len(cohorts) == 2
+    ids = np.arange(32)
+    assert tr2.store.fields == twin.store.fields
+    for name in tr2.store.fields:
+        np.testing.assert_array_equal(
+            tr2.store.gather(name, ids), twin.store.gather(name, ids)
+        )
+
+
+def test_cohort_axis_sharded_across_mesh():
+    """The cohort axis rides parallel/shardmap.py across the mesh: with
+    C=8 on the 8-device CPU mesh every device owns exactly one cohort
+    slot, and growing N leaves the per-device footprint unchanged."""
+    import jax
+
+    shapes = {}
+    for n_virtual in (8, 64):
+        cfg = tiny(
+            "fedavg",
+            nloop=1,
+            nadmm=1,
+            batch=10,
+            virtual_clients=n_virtual,
+            cohort=8,
+            data_shards=8,
+        )
+        tr = Trainer(cfg, verbose=False, source=SRC)
+        tr._begin_loop_cohort(0)
+        assert len(tr.flat.sharding.device_set) == len(jax.devices())
+        local = {
+            s.data.shape for s in tr.flat.addressable_shards
+        }
+        assert len(local) == 1
+        shapes[n_virtual] = next(iter(local))
+    # per-device slice identical whatever the population size
+    assert shapes[8] == shapes[64]
+    assert shapes[8][0] == 1  # one client row per device at C=8
