@@ -205,19 +205,121 @@ def _measure(preset: str, model: str | None, batch: int, steps: int,
     if flops and hbm_bytes:
         row["arithmetic_intensity"] = round(flops / hbm_bytes, 1)
 
-    # closure-evaluation accounting (the reference's one built-in counter,
-    # src/lbfgsnew.py:508-510): value_and_grad evals per optimizer step,
-    # cumulative in the threaded L-BFGS state over 1 warmup + the timed runs
+    # model-evaluation accounting (the reference's one built-in counter,
+    # src/lbfgsnew.py:508-510): value_and_grad evals + Armijo line-search
+    # probe evaluations per optimizer step, cumulative in the threaded
+    # L-BFGS state over 1 warmup + the timed runs. The probe-ladder term
+    # (LBFGSState.ls_evals, new with the multi-alpha fan) is what the
+    # roofline argument is about — each probe re-streams the parameter
+    # vector — and under `--linesearch-probes P` one widened fan charges
+    # its full width, so the amortization is reported honestly: P=4
+    # typically RAISES this number while the wall drops
+    # (probe_batch_speedup).
     try:
         import jax
 
         fe = np.asarray(jax.tree.leaves(lstate.func_evals)[0]).reshape(-1)
+        ls = np.asarray(jax.tree.leaves(lstate.ls_evals)[0]).reshape(-1)
+        denom = (1 + repeats) * steps
         row["mean_func_evals_per_step"] = round(
-            float(fe.mean()) / ((1 + repeats) * steps), 2
+            float((fe + ls).mean()) / denom, 2
         )
+        row["mean_ls_probe_evals_per_step"] = round(float(ls.mean()) / denom, 2)
     except Exception:
         pass
     return row
+
+
+def _probe_batch_probe():
+    """Warm epoch wall with the multi-alpha probe fan vs the sequential
+    line search (optim/linesearch.py, docs/PERF.md).
+
+    The roofline probe behind `--linesearch-probes`: the sequential
+    Armijo search walks its halving ladder one full forward pass per
+    rung (mean ~4 per step on the flagship — each pass re-streams the
+    parameter vector), while `P=4` evaluates 4 consecutive rungs in ONE
+    widened vmapped pass and selects on device. Both configs pick the
+    IDENTICAL alpha per step (the fan is the same ladder), so the timed
+    delta is pure dispatch-shape: `probe_batch_speedup` = warm epoch
+    wall at P=1 over P=4, medianized like every other probe. The honest
+    cost side rides along: `mean_func_evals_per_step` per config
+    (ls_evals included — P=4 charges its full fan width, so the number
+    RISES while the wall drops).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    k, batch, steps = 3, 40, 8
+    src = synthetic_cifar(n_train=k * batch * steps, n_test=60)
+    out = {"linesearch_probes": 4}
+    times, evals = {}, {}
+    for p in (1, 4):
+        cfg = get_preset(
+            "fedavg", n_clients=k, batch=batch, check_results=False,
+            synthetic_ok=True, max_scan_steps=None, linesearch_probes=p,
+        )
+        tr = Trainer(cfg, verbose=False, source=src)
+        gid = tr.group_order[0]
+        epoch_fn, _, init_fn = tr._fns(gid)
+        lstate, y, z, rho, extra = init_fn(tr.flat)
+        flat, stats = tr.flat, tr.stats
+        idx = tr._epoch_indices(0, gid, 0, 0)[:steps]
+
+        def run(flat, lstate, stats):
+            flat, lstate, stats, _ = epoch_fn(
+                flat, lstate, stats, tr.shard_imgs, tr.shard_labels,
+                idx, tr.mean, tr.std, y, z, rho,
+            )
+            return flat, lstate, stats
+
+        flat, lstate, stats = run(flat, lstate, stats)  # warmup/compile
+        float(jnp.sum(flat[:, 0]))
+        repeats = max(1, int(os.environ.get("BENCH_REPEATS", "5")))
+        dts = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            flat, lstate, stats = run(flat, lstate, stats)
+            float(jnp.sum(flat[:, 0]))
+            dts.append(time.perf_counter() - t0)
+        times[p] = float(np.median(dts))
+        fe = np.asarray(jax.tree.leaves(lstate.func_evals)[0]).reshape(-1)
+        ls = np.asarray(jax.tree.leaves(lstate.ls_evals)[0]).reshape(-1)
+        evals[p] = round(float((fe + ls).mean()) / ((1 + repeats) * steps), 2)
+    return {
+        **out,
+        "epoch_time_p1_s": round(times[1], 4),
+        "epoch_time_p4_s": round(times[4], 4),
+        # >= 1: the fan's amortization of the sequential per-rung
+        # parameter streams (the acceptance target is >= 1.3x on the
+        # line-search-enabled flagship config on real hardware)
+        "probe_batch_speedup": round(times[1] / times[4], 3),
+        "mean_func_evals_per_step_p1": evals[1],
+        "mean_func_evals_per_step_p4": evals[4],
+    }
+
+
+def _exchange_probe(tr_partition, group_order, gid, k):
+    """The bf16 exchange codec's ledger numbers for the measured
+    workload (exchange/, obs/ledger.py): exact uplink bytes of one
+    consensus exchange under `--exchange-dtype bfloat16` — half the f32
+    row — and the partial+codec savings vs the naive full-model f32
+    exchange. Pure partition/codec arithmetic, no device time.
+    """
+    from federated_pytorch_test_tpu.obs import CommLedger
+
+    ledger = CommLedger(
+        tr_partition, k, dtype_bytes=4, wire_bytes=2,
+        exchange_dtype="bfloat16",
+    )
+    return {
+        "exchange_dtype": "bfloat16",
+        "comm_bytes_per_round": ledger.round_bytes(gid, k),
+        "comm_savings_vs_full": round(ledger.savings_vs_full(group_order), 2),
+    }
 
 
 def _eval_tail_probe():
@@ -495,6 +597,30 @@ def main() -> None:
             )
     out["roofline"] = roof
 
+    # ---- the probe-batch probe: multi-alpha fan vs sequential search ----
+    try:
+        out["probe_batch"] = _probe_batch_probe()
+    except Exception as e:  # a failed probe must not kill the bench
+        out["probe_batch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # ---- the exchange-codec ledger numbers for the flagship group ----
+    try:
+        from federated_pytorch_test_tpu.engine import (
+            Trainer as _Tr,
+            get_preset as _gp,
+        )
+        from federated_pytorch_test_tpu.data import synthetic_cifar as _syn
+
+        _cfg = _gp("fedavg_resnet", n_clients=3, batch=32,
+                   check_results=False, synthetic_ok=True)
+        _tr = _Tr(_cfg, verbose=False,
+                  source=_syn(n_train=3 * 32, n_test=32))
+        out["exchange"] = _exchange_probe(
+            _tr.partition, _tr.group_order, _tr.group_order[0], 3
+        )
+    except Exception as e:
+        out["exchange"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # ---- the eval-tail probe: folded vs sync check_results rounds ----
     try:
         out["eval_tail"] = _eval_tail_probe()
@@ -659,6 +785,21 @@ def main() -> None:
         # sweep — the quantity the source paper's bandwidth claim is about
         "comm_bytes_per_round": flag.get("comm_bytes_per_round"),
         "comm_savings_vs_full": flag.get("comm_savings_vs_full"),
+        # the roofline probe facts (multi-alpha fan + bf16 codec PR,
+        # docs/PERF.md): honest per-step model-eval count (line-search
+        # probes included), the fan width the speedup row measures, warm
+        # epoch wall P=1/P=4 ratio, and the bf16 codec's halved uplink
+        "mean_func_evals_per_step": flag.get("mean_func_evals_per_step"),
+        "linesearch_probes": out.get("probe_batch", {}).get(
+            "linesearch_probes"
+        ),
+        "probe_batch_speedup": out.get("probe_batch", {}).get(
+            "probe_batch_speedup"
+        ),
+        "exchange_dtype": out.get("exchange", {}).get("exchange_dtype"),
+        "bf16_comm_bytes_per_round": out.get("exchange", {}).get(
+            "comm_bytes_per_round"
+        ),
     }
     # the eval-tail facts (fold/async eval PR): which eval mode the
     # engine defaults to, how many program launches a folded

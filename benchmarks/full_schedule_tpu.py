@@ -63,6 +63,18 @@ def main() -> None:
     # JAX persistent compilation cache: warm reruns of the same schedule
     # skip XLA backend compilation (config.compile_cache)
     ap.add_argument("--compile-cache", metavar="DIR", default=None)
+    # multi-alpha line-search fan width (config.linesearch_probes,
+    # docs/PERF.md): 1 = the sequential bitwise-identical search; 4 = the
+    # widened probe fan (same accepted alpha per step up to ulp ties,
+    # amortized parameter streaming — the roofline lever bench.py prices
+    # as probe_batch_speedup)
+    ap.add_argument("--linesearch-probes", type=int, default=None)
+    # exchange wire codec (config.exchange_dtype, exchange/): 'bfloat16'
+    # halves every exchange's uplink bytes; the recorded comm series and
+    # summary show the wire bytes exactly
+    ap.add_argument(
+        "--exchange-dtype", choices=["float32", "bfloat16"], default=None
+    )
     # load a REAL-FORMAT on-disk archive (scripts/make_cifar_archive.py
     # writes a checksum-verified one in the published binary layout) via
     # the real loader path — native bin decoding, no synthetic fallback
@@ -83,6 +95,10 @@ def main() -> None:
         over["fold_eval"] = False
     if args.compile_cache:
         over["compile_cache"] = args.compile_cache
+    if args.linesearch_probes is not None:
+        over["linesearch_probes"] = args.linesearch_probes
+    if args.exchange_dtype is not None:
+        over["exchange_dtype"] = args.exchange_dtype
     if args.stream:
         over.update(hbm_data_budget_mb=0, stream_chunk_steps=8)
     if args.real_archive:
@@ -181,6 +197,9 @@ def main() -> None:
             for r in rec.series.get("dispatch_count", [])
         ),
         "compile_cache": args.compile_cache,
+        # the roofline knobs this schedule ran under (docs/PERF.md)
+        "linesearch_probes": cfg.linesearch_probes,
+        "exchange_dtype": cfg.exchange_dtype,
         # the communication ledger (obs/ledger.py): exact per-exchange
         # uplink bytes and the end-of-run summary comparing the partial-
         # parameter schedule against the hypothetical full-model exchange
@@ -210,6 +229,10 @@ def main() -> None:
         suffix += "_nofused"
     if args.no_fold_eval:
         suffix += "_nofoldeval"
+    if cfg.exchange_dtype == "bfloat16":
+        suffix += "_bf16x"  # codec runs sit beside their f32 baselines
+    if cfg.linesearch_probes != 1:
+        suffix += f"_p{cfg.linesearch_probes}"
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"full_{args.preset}{suffix}_tpu.json",

@@ -20,6 +20,15 @@ boundaries (`--no-async-eval` restores the blocking per-eval fetch).
 `--compile-cache DIR` persists XLA executables so warm reruns skip
 backend compilation.
 
+Roofline levers (docs/PERF.md): `--linesearch-probes P` batches the
+L-BFGS Armijo search's sequential halving ladder into widened P-rung
+probe fans (P=1, the default, is bitwise the sequential search; P>1
+selects the identical step sizes while amortizing the per-probe
+parameter streams), and `--exchange-dtype bfloat16` ships every
+consensus uplink as bf16 — exactly half the ledger bytes; robust
+combiners and quarantine operate on the decoded f32 views. Both are
+trajectory-changing knobs and live in the metrics-stream tag.
+
 Chaos runs (fault/, docs/FAULT.md) ride the same config surface:
 `--fault-plan "seed=1,dropout=0.3,crash=0:1:2,corrupt=1:scale:10"` (or
 a FaultPlan JSON path, parsed strictly) injects replayable dropout/

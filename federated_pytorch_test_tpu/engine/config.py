@@ -15,6 +15,7 @@ import dataclasses
 from typing import Tuple
 
 from federated_pytorch_test_tpu.consensus import ADMMConfig, ROBUST_METHODS
+from federated_pytorch_test_tpu.exchange import EXCHANGE_DTYPES
 from federated_pytorch_test_tpu.optim import LBFGSConfig
 
 
@@ -159,6 +160,21 @@ class ExperimentConfig:
     # history traffic fused into two Pallas kernels, ops/compact_pallas.py)
     # or 'two_loop' (sequential recursion — the escape hatch)
     lbfgs_direction: str = "compact"
+    # batched multi-alpha Armijo fan width (optim/linesearch.py
+    # backtracking_armijo_probes_aux, docs/PERF.md): P candidate step
+    # sizes — consecutive rungs of the halving ladder from alphabar —
+    # evaluated in ONE widened vmapped pass per line-search iteration,
+    # with the first Armijo-satisfying rung selected on device. 1 (the
+    # default) dispatches to the UNCHANGED sequential search and is
+    # bitwise-identical to pre-probe builds; > 1 selects the same ladder
+    # rung (up to ulp-boundary Armijo ties) while the loss/aux values
+    # carry batched-reduction ulps, so this is a TRAJECTORY-CHANGING
+    # knob (it lives in the
+    # metrics-stream tag, unlike the dispatch-shape-only fold/async
+    # knobs). The roofline lever: the sequential search's mean ~4 probes
+    # per step each re-stream the full parameter vector from HBM; a fan
+    # streams once per P probes (bench.py probe_batch_speedup).
+    linesearch_probes: int = 1
 
     # ADMM (reference src/consensus_admm_trio.py:23,37-44)
     admm_rho0: float = 1e-3
@@ -172,6 +188,17 @@ class ExperimentConfig:
     # (> 0 enables; the reference ships it commented out but keeps the
     # helper, src/consensus_admm_trio_resnet.py:416-419)
     z_soft_threshold: float = 0.0
+
+    # exchange wire format (exchange/, docs/PERF.md): the codec applied
+    # to the UPLINKED partition-group slice of every consensus exchange.
+    # 'float32' is the identity codec — bit-transparent, the exact
+    # pre-codec program. 'bfloat16' halves the uplink bytes (the comm
+    # ledger records the wire bytes exactly); master weights, z, and all
+    # L-BFGS math stay f32, and the aggregation — mean, robust
+    # combiners, z-score quarantine — operates on the decoded f32 views.
+    # TRAJECTORY-CHANGING (one round-to-nearest-even per exchanged
+    # value), so it lives in the metrics-stream tag.
+    exchange_dtype: str = "float32"
 
     # HBM budget for the TRAINING data (MiB). None = the whole dataset is
     # put on device up front (fastest; the default — CIFAR is 150 MB).
@@ -459,6 +486,22 @@ class ExperimentConfig:
                 f"compute_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.compute_dtype!r}"
             )
+        if not isinstance(self.linesearch_probes, int) or isinstance(
+            self.linesearch_probes, bool
+        ):
+            raise ValueError(
+                f"linesearch_probes must be an int >= 1, "
+                f"got {self.linesearch_probes!r}"
+            )
+        if self.linesearch_probes < 1:
+            raise ValueError(
+                f"linesearch_probes must be >= 1, got {self.linesearch_probes}"
+            )
+        if self.exchange_dtype not in EXCHANGE_DTYPES:
+            raise ValueError(
+                f"exchange_dtype must be one of {list(EXCHANGE_DTYPES)}, "
+                f"got {self.exchange_dtype!r}"
+            )
         if self.fault_mode not in ("warn", "raise", "rollback", "off"):
             raise ValueError(
                 f"fault_mode must be 'warn', 'raise', 'rollback' or 'off', "
@@ -522,6 +565,7 @@ class ExperimentConfig:
             line_search=True,
             batch_mode=True,
             direction=self.lbfgs_direction,
+            ls_probes=self.linesearch_probes,
         )
 
     def admm_config(self) -> ADMMConfig:

@@ -21,7 +21,11 @@ slot-indexed inputs; in cohort mode the trainer projects them from
 virtual-client-keyed schedules before the dispatch (fault identity
 follows the virtual id, not the slot).
 * `vmap` over the local block — every client's L-BFGS step (line-search
-  probes included) is batched into single XLA ops;
+  probes included) is batched into single XLA ops; with
+  `--linesearch-probes P` the Armijo search's probe fan stacks a P-wide
+  alpha axis onto this client vmap, so one widened `[P*K]` forward
+  serves what the sequential search ran as P dependent per-client
+  passes (optim/linesearch.py, docs/PERF.md);
 * `lax.scan` over the epoch's minibatches — the per-step index gather
   happens on device from the resident uint8 shard, so a full epoch is one
   device computation with zero host round-trips.
@@ -81,6 +85,7 @@ from federated_pytorch_test_tpu.consensus import (
     update_suspects,
 )
 from federated_pytorch_test_tpu.data import normalize
+from federated_pytorch_test_tpu.exchange import get_codec
 from federated_pytorch_test_tpu.optim import (
     LBFGSConfig,
     lbfgs_init,
@@ -177,6 +182,12 @@ class GroupContext(NamedTuple):
     # lockstep programs; a ragged program fed all-full budgets is
     # bit-identical to them (every select picks the stepped operand).
     ragged: bool = False
+    # exchange wire format (exchange/, docs/PERF.md): the codec applied
+    # to the UPLINKED partition-group slice — the aggregation (mean,
+    # robust combiners, quarantine statistics) consumes the DECODED f32
+    # view while clients, master weights, and z stay f32. Static:
+    # 'float32' (identity codec) compiles the exact pre-codec program.
+    exchange_dtype: str = "float32"
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
@@ -616,10 +627,20 @@ def _consensus_local(ctx: GroupContext):
     if ctx.strategy == "none":
         return None
     quarantine = ctx.quarantine_z is not None
+    codec = get_codec(ctx.exchange_dtype)
+    # static: the identity codec compiles the exact pre-codec program
+    wire = not codec.is_identity
 
     def send_view(x, corr):
-        """The aggregation's view of the updates: corrupted in transit
-        when the plan says so (mode 0 selects the true bits verbatim)."""
+        """The aggregation's view of the updates: what the exchange
+        RECEIVED. The sender encodes its group slice through the wire
+        codec (exchange/ — decode back to f32 models the receiver's
+        view; identity is a no-op compiled away), and an in-transit
+        corruption fault garbles the wire AFTER the encoder (mode 0
+        selects the bits verbatim). Every consumer downstream — mean,
+        robust combiners, quarantine statistics — sees decoded f32."""
+        if wire:
+            x = codec.roundtrip(x)
         if not ctx.corrupt:
             return x
         return apply_corruption(x, *corr, gauss=ctx.corrupt_gauss)
@@ -670,7 +691,11 @@ def _consensus_local(ctx: GroupContext):
                 nadmm,
                 ctx.admm,
                 mask=mask,
-                x_agg=x_send if ctx.corrupt else None,
+                # the z-update consumes the exchange's RECEIVED view
+                # whenever it differs from the client's true x — codec
+                # wire format and/or in-transit corruption; None keeps
+                # the clean program's identical graph
+                x_agg=x_send if (ctx.corrupt or wire) else None,
                 combine=ctx.robust_agg,
                 robust_f=ctx.robust_f,
             )

@@ -47,6 +47,7 @@ from federated_pytorch_test_tpu.data import (
     virtual_shard_assignment,
 )
 from federated_pytorch_test_tpu.engine.config import ExperimentConfig
+from federated_pytorch_test_tpu.exchange import get_codec
 from federated_pytorch_test_tpu.engine.steps import (
     GroupContext,
     build_consensus_fn,
@@ -418,11 +419,16 @@ class Trainer:
         # its truncation point is the restored loop cursor.
         self._dispatch = DispatchCounter()
         self._diag_fn = None  # jitted group_distances, built on first use
+        # the ledger counts WIRE bytes (exchange/ codec — half per value
+        # under bf16) against the full-model PARAMETER-width baseline
+        wire_dtype = cfg.exchange_dtype if cfg.strategy != "none" else "float32"
         self._comm = CommLedger(
             self.partition,
             cfg.n_clients,
             dtype_bytes=int(jnp.dtype(self.flat.dtype).itemsize),
             data_floor_bytes=int(data_bytes),
+            wire_bytes=get_codec(wire_dtype).bytes_per_value,
+            exchange_dtype=wire_dtype,
         )
         if cfg.trace_out and jax.process_index() == 0:
             self.recorder.tracer = TraceRecorder()
@@ -537,6 +543,10 @@ class Trainer:
         # `fold_eval`/`async_eval` are dispatch-shape knobs whose record
         # streams are identical by contract (tests/test_fold_eval.py) —
         # a resumed run may flip any of them and still splice.
+        # `linesearch_probes` and `exchange_dtype` are deliberately NOT
+        # excluded: both change the trajectory (batched-reduction ulps /
+        # wire rounding), so a resumed run that flips either must refuse
+        # to splice (tests/test_exchange.py).
         for k in (
             "metrics_stream", "trace_out", "profile_dir", "resume",
             "compile_cache", "fold_eval", "async_eval",
@@ -635,6 +645,12 @@ class Trainer:
                 and self.injector.plan.corrupt_mode == "gauss"
             ),
             ragged=self._ragged_enabled(),
+            # the wire codec only exists where an exchange does; keeping
+            # strategy-'none' contexts on the identity codec means their
+            # programs (and cache keys) ignore the knob entirely
+            exchange_dtype=(
+                cfg.exchange_dtype if cfg.strategy != "none" else "float32"
+            ),
         )
 
     def _quarantine_enabled(self) -> bool:

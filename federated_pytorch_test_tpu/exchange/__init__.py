@@ -1,0 +1,38 @@
+"""Exchange wire-format codecs: what actually crosses the interconnect.
+
+The comm ledger (obs/ledger.py, PR 3) made the paper's bandwidth claim a
+measured number; this package moves the number. TAMUNA
+(arXiv:2302.09832) and L-FGADMM (arXiv:1911.03654) both argue that
+compressed / partial exchange is where communication-efficient federated
+optimization actually wins — the codec protocol here is the seed of
+ROADMAP item 3's pluggable-codec interface (top-k sparsification,
+stochastic quantization, sparse masks), shipping with its two simplest
+members: `identity` (f32 on the wire, bit-transparent — the pre-codec
+program compiles unchanged) and `bf16` (half the uplink bytes, one
+round-to-nearest-even per value).
+
+Placement contract (engine/steps.py `_consensus_local`): the codec wraps
+the UPLINKED partition-group slice only. Master weights, the consensus
+variable z, and all L-BFGS math stay f32; the aggregation — mean, the
+robust order-statistic combiners, AND the z-score auto-quarantine — all
+operate on the DECODED f32 views, so a bf16-encoded liar is still
+quarantined (tests/test_exchange.py). In-transit corruption faults
+(fault/plan.py) garble the decoded view: the adversary sits on the wire,
+after the sender's encoder.
+"""
+
+from federated_pytorch_test_tpu.exchange.codec import (
+    EXCHANGE_DTYPES,
+    Bf16Codec,
+    ExchangeCodec,
+    IdentityCodec,
+    get_codec,
+)
+
+__all__ = [
+    "EXCHANGE_DTYPES",
+    "Bf16Codec",
+    "ExchangeCodec",
+    "IdentityCodec",
+    "get_codec",
+]

@@ -1,0 +1,107 @@
+"""The codec protocol and its two shipped members (package docstring).
+
+A codec is three pure functions plus static wire metadata:
+
+* `encode(x)`  — the sender's half: f32 group slice -> wire array;
+* `decode(w)`  — the receiver's half: wire array -> f32 view (what every
+  combiner, residual, and quarantine statistic consumes);
+* `bytes_on_wire(n_values)` — EXACT uplink bytes of one client's encoded
+  slice, the quantity the comm ledger records (obs/ledger.py: a codec
+  that cannot state its bytes exactly does not belong on the ledger).
+
+Codecs must be jit-traceable (encode/decode run INSIDE the fused round
+program) and deterministic — fused and unfused chaos runs must decode
+identical views. `is_identity` is a STATIC build flag: the engine skips
+the roundtrip entirely for the identity codec, so an
+`--exchange-dtype float32` run compiles the exact pre-codec program
+(the bitwise fallback, tests/test_exchange.py).
+
+Future members (ROADMAP item 3: top-k, stochastic quantization,
+TAMUNA-style sparse masks) implement the same three functions;
+`bytes_on_wire` is per-value-count rather than per-array so sparse
+codecs can report index + payload bytes exactly. NOTE: today's ledger
+consumes the flat `bytes_per_value` (obs/ledger.py `wire_bytes` — exact
+for both dense members here); landing the first sparse codec means
+passing `bytes_on_wire` itself through to the ledger's round arithmetic,
+which is the point at which this protocol method stops being
+forward-looking and becomes the wire contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+# the `--exchange-dtype` vocabulary (engine/config.py validates against
+# this; the CLI error names the field)
+EXCHANGE_DTYPES = ("float32", "bfloat16")
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeCodec:
+    """Base codec: f32 on the wire, bit-transparent."""
+
+    name: str = "identity"
+    bytes_per_value: int = 4
+    is_identity: bool = True
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x
+
+    def decode(self, wire: jnp.ndarray) -> jnp.ndarray:
+        return wire
+
+    def roundtrip(self, x: jnp.ndarray) -> jnp.ndarray:
+        """encode→decode — the aggregation's view of the sent slice."""
+        return self.decode(self.encode(x))
+
+    def bytes_on_wire(self, n_values: int) -> int:
+        """Exact uplink bytes of one client's `n_values`-value slice."""
+        return self.bytes_per_value * int(n_values)
+
+
+class IdentityCodec(ExchangeCodec):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Bf16Codec(ExchangeCodec):
+    """bfloat16 on the wire: exactly half the f32 uplink.
+
+    encode rounds f32 -> bf16 (round-to-nearest-even, the one lossy
+    operation); decode widens bf16 -> f32 exactly (bf16 is a prefix of
+    f32: 8 exponent bits, 7 mantissa bits — every bf16 value is exactly
+    representable in f32, so decode(encode(x)) == x whenever x already
+    has a 7-bit mantissa, and differs by <= 2^-8 relative otherwise).
+    Non-finite values survive the roundtrip as themselves (a nan_burst
+    liar still looks non-finite to the combiners' exclusion logic and
+    the quarantine's finiteness flag).
+    """
+
+    name: str = "bf16"
+    bytes_per_value: int = 2
+    is_identity: bool = False
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(jnp.bfloat16)
+
+    def decode(self, wire: jnp.ndarray) -> jnp.ndarray:
+        return wire.astype(jnp.float32)
+
+
+_CODECS = {
+    "float32": IdentityCodec(),
+    "bfloat16": Bf16Codec(),
+}
+
+
+def get_codec(exchange_dtype: str) -> ExchangeCodec:
+    """The codec for a config's `exchange_dtype` knob."""
+    try:
+        return _CODECS[exchange_dtype]
+    except KeyError:
+        raise ValueError(
+            f"exchange_dtype must be one of {list(EXCHANGE_DTYPES)}, "
+            f"got {exchange_dtype!r}"
+        ) from None

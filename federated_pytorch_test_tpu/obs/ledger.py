@@ -19,6 +19,10 @@ series is the UPLINK volume of one exchange,
 
 the hand-computable contract of tests/test_obs.py; the symmetric
 consensus broadcast doubles it, which the summary reports separately.
+Under an exchange codec (exchange/, `--exchange-dtype bfloat16`)
+`dtype_bytes` above becomes the codec's WIRE bytes-per-value — exactly
+half under bf16 — while the full-exchange baseline below keeps the
+parameter width (compression is part of the savings being measured).
 
 Two baselines put the number in context:
 
@@ -46,10 +50,21 @@ class CommLedger:
         n_clients: int,
         dtype_bytes: int = 4,
         data_floor_bytes: Optional[int] = None,
+        wire_bytes: Optional[int] = None,
+        exchange_dtype: str = "float32",
     ):
+        """`dtype_bytes` is the PARAMETER dtype's width (what the naive
+        full-model f32 exchange baseline ships); `wire_bytes` is what one
+        exchanged value actually costs on the wire under the exchange
+        codec (exchange/ — half of dtype_bytes under bf16). Defaults to
+        dtype_bytes: pre-codec ledgers are unchanged."""
         self.partition = partition
         self.n_clients = int(n_clients)
         self.dtype_bytes = int(dtype_bytes)
+        self.wire_bytes = (
+            int(wire_bytes) if wire_bytes is not None else int(dtype_bytes)
+        )
+        self.exchange_dtype = str(exchange_dtype)
         self.data_floor_bytes = (
             int(data_floor_bytes) if data_floor_bytes is not None else None
         )
@@ -64,22 +79,29 @@ class CommLedger:
     # --------------------------------------------------------- pure queries
 
     def round_bytes(self, gid: int, survivors: int) -> int:
-        """Uplink bytes of ONE consensus exchange of group `gid`."""
-        return self.partition.group_size(gid) * self.dtype_bytes * int(survivors)
+        """Uplink bytes of ONE consensus exchange of group `gid` — at the
+        WIRE width: the codec's bytes-per-value, exactly half the f32
+        ledger under the bf16 codec (tests/test_exchange.py hand-check)."""
+        return self.partition.group_size(gid) * self.wire_bytes * int(survivors)
 
     def full_round_bytes(self, survivors: int) -> int:
-        """The same exchange if the WHOLE parameter vector were sent."""
+        """The same exchange if the WHOLE parameter vector were sent —
+        at the PARAMETER width (the naive uncompressed-full-model
+        baseline the savings ratio is measured against)."""
         return self.partition.total * self.dtype_bytes * int(survivors)
 
     def savings_vs_full(self, group_order: Sequence[int]) -> float:
         """Partial-vs-full ratio for one pass over `group_order`.
 
-        Pure partition arithmetic (participation cancels): how many times
-        MORE a whole-model exchange would move than the per-group one,
-        over one outer loop's visit order.
+        Pure partition + codec arithmetic (participation cancels): how
+        many times MORE a whole-model f32 exchange would move than the
+        per-group wire-format one, over one outer loop's visit order —
+        the codec's compression factor multiplies the partition's.
         """
         part = sum(self.partition.group_size(g) for g in group_order)
-        return self.partition.total * len(group_order) / part
+        return (
+            self.partition.total * len(group_order) * self.dtype_bytes
+        ) / (part * self.wire_bytes)
 
     # ---------------------------------------------------------- accumulation
 
@@ -144,6 +166,10 @@ class CommLedger:
             "rounds": self._rounds,
             "n_clients": self.n_clients,
             "dtype_bytes": self.dtype_bytes,
+            # the wire format (exchange/): what one exchanged value
+            # actually cost on the uplink under the active codec
+            "exchange_dtype": self.exchange_dtype,
+            "wire_bytes_per_value": self.wire_bytes,
             "bytes_total": int(up),
             "bytes_total_bidirectional": int(2 * up),
             "bytes_per_round_mean": (

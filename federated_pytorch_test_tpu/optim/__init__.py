@@ -12,7 +12,10 @@ single fused device computation with no host round-trips.
 """
 
 from federated_pytorch_test_tpu.optim.compact import compact_direction
-from federated_pytorch_test_tpu.optim.linesearch import vma_zero
+from federated_pytorch_test_tpu.optim.linesearch import (
+    backtracking_armijo_probes_aux,
+    vma_zero,
+)
 from federated_pytorch_test_tpu.optim.lbfgs import (
     LBFGSConfig,
     LBFGSState,
@@ -24,6 +27,7 @@ __all__ = [
     "vma_zero",
     "LBFGSConfig",
     "LBFGSState",
+    "backtracking_armijo_probes_aux",
     "compact_direction",
     "lbfgs_init",
     "lbfgs_step",

@@ -46,6 +46,7 @@ from jax import lax
 from federated_pytorch_test_tpu.optim.compact import compact_direction
 from federated_pytorch_test_tpu.optim.linesearch import (
     backtracking_armijo_aux,
+    backtracking_armijo_probes_aux,
     vma_zero,
     backtracking_armijo,
     cubic_linesearch,
@@ -86,12 +87,27 @@ class LBFGSConfig:
     #   contractions, one for the direction assembly (see
     #   ops/compact_pallas.py; interpret mode off-TPU).
     direction: str = "compact"
+    # batched multi-alpha Armijo fan width (batch-mode line search only,
+    # linesearch.backtracking_armijo_probes_aux): each line-search loop
+    # iteration evaluates this many halving-ladder rungs in ONE widened
+    # vmapped pass and selects the first Armijo-satisfying rung on
+    # device. 1 = the sequential search, DISPATCHED to the unchanged
+    # `backtracking_armijo_aux` so the trajectory is bitwise-identical to
+    # pre-probe builds; > 1 selects the same ladder rung (up to
+    # ulp-boundary Armijo ties under batched reduction) while amortizing
+    # the sequential per-probe parameter re-streams into fans
+    # (docs/PERF.md).
+    ls_probes: int = 1
 
     def __post_init__(self):
         if self.direction not in ("compact", "two_loop", "pallas"):
             raise ValueError(
                 "direction must be 'compact', 'two_loop' or 'pallas', "
                 f"got {self.direction!r}"
+            )
+        if self.ls_probes < 1:
+            raise ValueError(
+                f"ls_probes must be >= 1, got {self.ls_probes}"
             )
 
     @property
@@ -115,6 +131,16 @@ class LBFGSState(NamedTuple):
     func_evals: jnp.ndarray  # i32
     running_avg: jnp.ndarray  # [N] inter-batch gradient mean (batch mode)
     running_avg_sq: jnp.ndarray  # [N] inter-batch second-moment accumulator
+    # i32, cumulative Armijo line-search probe evaluations (batch-mode
+    # line search only; the cubic search and fixed-step mode contribute
+    # 0). Separate from `func_evals` on purpose: func_evals keeps its
+    # historical meaning (entry + re-evaluations — the quantity the
+    # `max_eval` budget is charged against), while this counter makes the
+    # line search's forward passes visible — the roofline quantity
+    # bench.py's `mean_func_evals_per_step` reports (func_evals +
+    # ls_evals per step). Under `ls_probes > 1` one widened fan charges
+    # its full fan width: the amortization is honest, not hidden.
+    ls_evals: jnp.ndarray
 
 
 class LBFGSAux(NamedTuple):
@@ -139,6 +165,9 @@ class LBFGSAux(NamedTuple):
     # instead of a different quantity entirely (`loss` is the total
     # objective, penalties included).
     entry_aux: Any = ()
+    # Armijo line-search probe evaluations this step (see
+    # LBFGSState.ls_evals — this is the per-step delta)
+    ls_evals: jnp.ndarray | int = 0
 
 
 def lbfgs_init(x0: jnp.ndarray, config: LBFGSConfig) -> LBFGSState:
@@ -165,6 +194,7 @@ def lbfgs_init(x0: jnp.ndarray, config: LBFGSConfig) -> LBFGSState:
         func_evals=jnp.int32(0),
         running_avg=z,
         running_avg_sq=z,
+        ls_evals=jnp.int32(0),
     )
 
 
@@ -252,6 +282,7 @@ class _Carry(NamedTuple):
     done: jnp.ndarray
     aux: Any  # user aux of the last evaluation at the carry's x
     aux_ok: jnp.ndarray  # False while x was produced by the NaN fallback
+    ls_evals: jnp.ndarray  # i32, Armijo probe evaluations this step
 
 
 def lbfgs_step(
@@ -405,6 +436,7 @@ def lbfgs_step(
 
         aux_new = c.aux
         aux_ok_new = c.aux_ok
+        ls_evals = c.ls_evals
         if config.line_search:
             x_cur = c.x
 
@@ -412,9 +444,21 @@ def lbfgs_step(
                 return loss_fn_aux(x_cur + alpha * d)
 
             if config.batch_mode:
-                t_ls, _, aux_ls = backtracking_armijo_aux(
-                    phi_aux, c.loss, gtd, alphabar
-                )
+                # static dispatch on the fan width: ls_probes == 1 keeps
+                # the UNCHANGED sequential search — the bitwise fallback —
+                # while > 1 evaluates fans of consecutive halving rungs
+                # in one widened pass (same accepted alpha, amortized
+                # parameter streaming; linesearch.py)
+                if config.ls_probes > 1:
+                    t_ls, ls_ev, aux_ls = backtracking_armijo_probes_aux(
+                        phi_aux, c.loss, gtd, alphabar,
+                        probes=config.ls_probes,
+                    )
+                else:
+                    t_ls, ls_ev, aux_ls = backtracking_armijo_aux(
+                        phi_aux, c.loss, gtd, alphabar
+                    )
+                ls_evals = c.ls_evals + ls_ev
                 aux_new = aux_ls
                 # a NaN step size falls back to lr below: the point
                 # x + lr*d was never evaluated, so the carried aux does
@@ -482,6 +526,7 @@ def lbfgs_step(
             done=done,
             aux=aux_new,
             aux_ok=aux_ok_new,
+            ls_evals=ls_evals,
         )
 
     # Exact zeros carrying the loss's varying-mesh-axis type. Under
@@ -516,6 +561,7 @@ def lbfgs_step(
         # and aux0 is exactly its aux
         aux=aux0,
         aux_ok=vz == 0,
+        ls_evals=jnp.int32(0) + iz,
     )
 
     def masked_body(c: _Carry) -> _Carry:
@@ -546,6 +592,7 @@ def lbfgs_step(
         func_evals=state.func_evals + final.evals,
         running_avg=final.running_avg,
         running_avg_sq=final.running_avg_sq,
+        ls_evals=state.ls_evals + final.ls_evals,
     )
     aux = LBFGSAux(
         loss=loss0,
@@ -557,5 +604,6 @@ def lbfgs_step(
         # aux0 rides along untouched by the loop; unused leaves (e.g. the
         # engine's entry BN stats) are dead code XLA eliminates
         entry_aux=aux0,
+        ls_evals=final.ls_evals,
     )
     return final.x, new_state, aux

@@ -126,6 +126,121 @@ def backtracking_armijo(
     return alpha, evals
 
 
+def backtracking_armijo_probes_aux(
+    phi_aux,
+    f_old: Scalar,
+    gtd: Scalar,
+    alphabar: Scalar,
+    c1: float = 1e-4,
+    max_iters: int = 35,
+    probes: int = 4,
+):
+    """Batched multi-alpha Armijo: `probes` candidate steps per widened pass.
+
+    The sequential search (`backtracking_armijo_aux`) walks the halving
+    ladder `alphabar * 2^-j`, j = 0..max_iters, one full forward pass per
+    probe — on the memory-bound L-BFGS roofline each pass re-streams the
+    whole parameter vector from HBM (docs/PERF.md). Here each loop
+    iteration evaluates a FAN of `probes` consecutive ladder rungs in ONE
+    `jax.vmap`ped pass (the alpha axis stacks onto whatever batching the
+    caller already runs — in the engine, the K-client vmap) and selects
+    the first Armijo-satisfying rung on device.
+
+    The SELECTED alpha matches the sequential search's: both accept the
+    first rung j with
+    `f(alphabar·2^-j) <= f_old + alphabar·2^-j · c1·gtd`, falling back to
+    rung `max_iters` when none satisfies (exact for any `probes` when
+    `phi_aux` is deterministic scalar code, the unit-proven property).
+    One caveat in the widened engine pass: the fan evaluates `phi_aux` as
+    a `[P·K]` batch, so XLA reduction order can move a loss by an ulp,
+    and a rung sitting exactly on the Armijo threshold may flip its
+    accept — same ladder, same rule, identical up to ulp-boundary ties.
+    That (plus the batched-reduction ulps in the carried loss/aux) is why
+    `probes == 1` callers must use `backtracking_armijo_aux` itself (the
+    engine dispatches on the static `LBFGSConfig.ls_probes`) — that path
+    is the bitwise fallback, this one is the amortized fan — and why
+    `ls_probes` is a stream-tagged trajectory-changing knob.
+
+    Returns `(alpha, n_evals, aux)` where `n_evals` counts EVERY ladder
+    rung actually evaluated (`probes` per executed fan, minus rungs past
+    `max_iters` masked out of the final fan) — the honest amortization
+    accounting behind bench.py's `mean_func_evals_per_step`. The aux
+    belongs to the returned alpha, as in the sequential search.
+
+    vmap-safe like the sequential loop: a client whose fan already
+    accepted keeps its carry frozen while siblings keep fanning.
+    """
+    if probes < 1:
+        raise ValueError(f"probes must be >= 1, got {probes}")
+    prod = c1 * gtd
+    dt = jnp.asarray(alphabar).dtype
+    n_rungs = max_iters + 1  # the sequential search evaluates at most these
+    n_fans = -(-n_rungs // probes)
+    offsets = jnp.arange(probes, dtype=dt)
+    # per-fan ladder factors: fan i covers rungs i*P .. i*P+P-1
+    fan_step = jnp.asarray(0.5**probes, dt)
+
+    def fan_eval(base, j0):
+        """One widened pass over `probes` consecutive rungs from `base`."""
+        alphas = base * (0.5**offsets)
+        losses, auxs = jax.vmap(phi_aux)(alphas)
+        rung = j0 + jnp.arange(probes, dtype=jnp.int32)
+        valid = rung < n_rungs
+        ok = valid & ~(losses > f_old + alphas * prod)
+        any_ok = ok.any()
+        first_ok = jnp.argmax(ok)
+        last_valid = jnp.minimum(probes - 1, n_rungs - 1 - j0)
+        pick = jnp.where(any_ok, first_ok, last_valid).astype(jnp.int32)
+        sel = lambda a: jnp.take(a, pick, axis=0)
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        return (
+            sel(alphas),
+            sel(losses),
+            jax.tree.map(sel, auxs),
+            any_ok,
+            n_valid,
+            # exhausting the ladder terminates like the sequential budget
+            any_ok | (j0 + last_valid >= max_iters),
+        )
+
+    # fan 0 runs unconditionally (the sequential search always evaluates
+    # alphabar); the loop continues only while unaccepted rungs remain
+    a0, l0, aux0, _, ev0, done0 = fan_eval(alphabar, jnp.int32(0))
+    vz = vma_zero(f_old)
+    iz = vz.astype(jnp.int32)
+
+    def cond(carry):
+        (fan, _, _, _, _, done), _ = carry
+        return jnp.logical_and(fan < n_fans - 1, jnp.logical_not(done))
+
+    def body(carry):
+        (fan, base, alpha, loss, aux, done), evals = carry
+        # the NEXT fan: rungs (fan+1)*P .. , starting P rungs below `base`
+        a, l, x, _, ev_f, done_f = fan_eval(base * fan_step, (fan + 1) * probes)
+        new = (fan + 1, base * fan_step, a, l, x, done_f)
+        frozen = _freeze(done, new, (fan, base, alpha, loss, aux, done))
+        # a frozen client's fan result is discarded, so its count must
+        # not grow either (the fan still RAN under vmap, but the honest
+        # per-client accounting charges only the evaluations that could
+        # influence that client's accepted step)
+        evals = jnp.where(done, evals, evals + ev_f)
+        return frozen, evals
+
+    init = (
+        (
+            jnp.int32(0) + iz,
+            alphabar + vz,
+            a0 + vz,
+            l0 + vz,
+            aux0,
+            done0 | (vz != 0),
+        ),
+        ev0 + iz,
+    )
+    (_, _, alpha, _, aux, _), evals = lax.while_loop(cond, body, init)
+    return alpha, evals, aux
+
+
 class _CubicConsts(NamedTuple):
     sigma: float = 0.1
     rho: float = 0.01

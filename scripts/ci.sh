@@ -42,7 +42,12 @@
 #                                    + trimmed combiner + planned crash,
 #                                    recovered via rerun, crashed+resumed
 #                                    stream identical to the
-#                                    uninterrupted twin's) and
+#                                    uninterrupted twin's), bf16_smoke
+#                                    (bf16 exchange codec + trimmed
+#                                    combiner + corruption + quarantine
+#                                    + planned crash recovered via
+#                                    rerun, halved comm ledger asserted
+#                                    on the stream) and
 #                                    cohort_smoke (10k virtual clients,
 #                                    C=8 cohorts, dropout+corruption
 #                                    keyed by virtual id, trimmed
@@ -187,6 +192,71 @@ assert any(d.get("series") == "client_time" for d in recs)
   rm -rf "$d"
 }
 
+bf16_smoke() {
+  # End-to-end bf16 exchange codec through the REAL CLI (exchange/,
+  # docs/PERF.md): every consensus exchange ships the group slice as
+  # bfloat16 (half the uplink bytes on the ledger), one client per round
+  # sends a 10x-scaled update, trimmed-mean(1) + auto-quarantine defend
+  # ON THE DECODED f32 VIEWS, and a planned crash at (nloop=1, gid=2,
+  # nadmm=0) kills the first run. Recovery is rerunning the IDENTICAL
+  # command; an uninterrupted twin (same plan minus the crash) then
+  # proves crashed+resumed stream identity under the codec — comm_bytes
+  # records included (exactly half the f32 ledger, asserted below) —
+  # with zero rollbacks and the quarantine still firing.
+  local d; d="$(mktemp -d)"
+  local common=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 240 --synthetic-n-test 60 --batch 40
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --exchange-dtype bfloat16
+    --robust-agg trimmed --robust-f 1 --quarantine-z 1.0
+    --fault-mode rollback --save-model --resume auto)
+  local cmd=("${common[@]}"
+    --fault-plan "seed=5,corrupt=1:scale:10,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${common[@]}"
+    --fault-plan "seed=5,corrupt=1:scale:10"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  echo "bf16 smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "bf16 smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "bf16 smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "bf16 smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "bf16 smoke FAILED: the uninterrupted twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  if grep -q 'round_rollback' "$d/run.jsonl"; then
+    echo "bf16 smoke FAILED: the codec broke the robust combiner (rollback)" >&2
+    rm -rf "$d"; return 1
+  fi
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+comm = [d for d in recs if d.get("series") == "comm_bytes"]
+assert comm, "no comm_bytes records"
+summ = [d for d in recs if d.get("series") == "comm_summary"][-1]["value"]
+assert summ["exchange_dtype"] == "bfloat16", summ
+assert summ["wire_bytes_per_value"] == 2, summ
+# half the f32 ledger exactly: per-survivor wire bytes are constant
+# across exchanges (one group) and 2 bytes/value — i.e. exactly half the
+# 4-byte parameter width (the exact hand-check vs masks lives in
+# tests/test_exchange.py; here the stream must be self-consistent)
+per = {d["value"] // d["survivors"] for d in comm if d["survivors"]}
+assert len(per) == 1, per
+assert summ["bytes_total"] == sum(d["value"] for d in comm), summ
+assert any(d.get("series") == "quarantine" for d in recs), (
+    "quarantine never fired under the codec")
+' || {
+    echo "bf16 smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "bf16 smoke OK"
+  rm -rf "$d"
+}
+
 cohort_smoke() {
   # End-to-end cross-device scale through the REAL CLI (clients/,
   # docs/SCALE.md): 10k virtual clients mapped onto 8 data shards, a
@@ -253,6 +323,7 @@ case "$tier" in
     python -m pytest tests/ -m slow -q "$@"
     chaos_smoke
     hetero_smoke
+    bf16_smoke
     cohort_smoke
     ;;
   all)
@@ -260,6 +331,7 @@ case "$tier" in
     python -m pytest tests/ -m slow -q "$@"
     chaos_smoke
     hetero_smoke
+    bf16_smoke
     cohort_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;

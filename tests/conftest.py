@@ -15,6 +15,8 @@ imports jax numerics, hence it lives at conftest import time.
 
 import os
 
+import pytest
+
 # silence the cache loader's per-entry E-level banner (multi-KB of
 # machine-feature noise per hit). TSL reads this env var at the FIRST
 # C++ log emission, which happens during backend init inside
@@ -32,3 +34,54 @@ _cache = compile_cache_dir()
 os.makedirs(_cache, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+# --------------------------------------------- the shared acceptance run
+#
+# test_robust.py (Byzantine gates) and test_exchange.py (codec gates)
+# both compare against THE SAME fault-free f32 baseline on the same
+# discriminating synthetic. Session scope keeps it to one ~70 s trainer
+# run for the whole suite instead of one per module — the tier-1 wall
+# (ROADMAP's 870 s gate) pays for every duplicate.
+
+
+@pytest.fixture(scope="session")
+def src_hard_accept():
+    """The discriminating acceptance oracle (data/cifar.py): label noise
+    + prototype overlap keep accuracy off the ceiling, so robustness or
+    codec damage SHOWS as lost points instead of hiding behind a
+    separable toy task."""
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+
+    return synthetic_cifar(
+        n_train=240, n_test=240, label_noise=0.25, overlap=0.35
+    )
+
+
+@pytest.fixture(scope="session")
+def accept_cfg():
+    """Builder for the acceptance-gate config — the ONE definition both
+    gate modules derive their variants from (a drifted copy would gate
+    against a different baseline than it runs)."""
+    from federated_pytorch_test_tpu.engine import get_preset
+
+    def build(**over):
+        base = dict(
+            batch=40, nloop=2, nadmm=3, max_groups=1, model="net",
+            check_results=True, eval_batch=80, fault_mode="rollback",
+            synthetic_ok=True,
+        )
+        base.update(over)
+        return get_preset("fedavg", **base)
+
+    return build
+
+
+@pytest.fixture(scope="session")
+def fault_free_accept(src_hard_accept, accept_cfg):
+    """The completed fault-free f32 acceptance run (trainer, post-run)."""
+    from federated_pytorch_test_tpu.engine import Trainer
+
+    tr = Trainer(accept_cfg(), verbose=False, source=src_hard_accept)
+    tr.run()
+    return tr

@@ -363,16 +363,6 @@ def _src():
     return synthetic_cifar(n_train=240, n_test=60)
 
 
-@pytest.fixture(scope="module")
-def _src_hard():
-    # discriminating oracle (data/cifar.py docstring): the plain synthetic
-    # set is nearly separable — every healthy config reaches ~1.0 and a
-    # poisoned consensus can coast on argmax invariance. Label noise +
-    # prototype overlap give the accuracy curve shape, so corruption
-    # damage SHOWS as lost points.
-    return synthetic_cifar(n_train=240, n_test=240, label_noise=0.25, overlap=0.35)
-
-
 def _tiny(preset="fedavg", **over):
     base = dict(
         batch=40, nloop=1, nadmm=2, max_groups=1, model="net",
@@ -445,16 +435,12 @@ def test_corrupted_round_fused_equals_unfused(_src):
 
 
 # ------------------------------------------------- the acceptance contract
-
-
-def _accept_cfg(**over):
-    base = dict(
-        batch=40, nloop=2, nadmm=3, max_groups=1, model="net",
-        check_results=True, eval_batch=80, fault_mode="rollback",
-        synthetic_ok=True,
-    )
-    base.update(over)
-    return get_preset("fedavg", **base)
+#
+# the discriminating oracle (`src_hard_accept` — label noise + prototype
+# overlap keep accuracy off the ceiling so corruption damage SHOWS), the
+# gate config builder (`accept_cfg`) and the fault-free f32 baseline run
+# (`fault_free_accept`) are session fixtures in conftest.py, shared with
+# test_exchange.py's codec gates — one baseline run for the whole suite.
 
 
 def _final_acc(tr):
@@ -466,25 +452,27 @@ def _fault_kinds(tr):
     return [f["value"]["kind"] for f in tr.recorder.series.get("fault", [])]
 
 
-@pytest.fixture(scope="module")
-def fault_free_run(_src_hard):
-    tr = Trainer(_accept_cfg(), verbose=False, source=_src_hard)
-    tr.run()
-    return tr
-
-
-@pytest.mark.parametrize("mode", ["scale", "nan_burst"])
-def test_trimmed_survives_corruption_mean_does_not(mode, _src_hard, fault_free_run):
+# the nan_burst leg re-runs the identical gate with a second corruption
+# mode; tier-1 sits at the 870 s driver timeout (the wall, not the test
+# count, is the scarce resource — measured 859 s at the pre-PR-9 seed), so
+# the scale leg carries the gate in tier-1 and nan_burst rides tier-2
+@pytest.mark.parametrize(
+    "mode",
+    ["scale", pytest.param("nan_burst", marks=pytest.mark.slow)],
+)
+def test_trimmed_survives_corruption_mean_does_not(
+    mode, src_hard_accept, fault_free_accept, accept_cfg
+):
     """THE acceptance gate: one client corrupted per round (scale λ=10 /
     nan_burst). trimmed(f=1) finishes with ZERO rollback rounds and
     fault-free-level accuracy (within 2 points) in the folded one-dispatch
     round; mean on the same plan degrades to chance or rolls back."""
     plan = f"seed=7,corrupt=1:{mode}:10"
-    acc_free = _final_acc(fault_free_run)
+    acc_free = _final_acc(fault_free_accept)
 
     tr = Trainer(
-        _accept_cfg(fault_plan=plan, robust_agg="trimmed", robust_f=1),
-        verbose=False, source=_src_hard,
+        accept_cfg(fault_plan=plan, robust_agg="trimmed", robust_f=1),
+        verbose=False, source=src_hard_accept,
     )
     tr.run()
     assert "round_rollback" not in _fault_kinds(tr)
@@ -496,8 +484,8 @@ def test_trimmed_survives_corruption_mean_does_not(mode, _src_hard, fault_free_r
         assert r["value"] == {"round": 1, "round_init": 1, "total": 2}
 
     tm = Trainer(
-        _accept_cfg(fault_plan=plan, robust_agg="mean"),
-        verbose=False, source=_src_hard,
+        accept_cfg(fault_plan=plan, robust_agg="mean"),
+        verbose=False, source=src_hard_accept,
     )
     tm.run()
     rolled = "round_rollback" in _fault_kinds(tm)
@@ -506,11 +494,15 @@ def test_trimmed_survives_corruption_mean_does_not(mode, _src_hard, fault_free_r
     assert rolled or degraded, (mode, acc_m, acc_free, _fault_kinds(tm))
 
 
+@pytest.mark.slow
 def test_crash_resume_stream_identity_with_quarantine_records(_src, tmp_path):
     """The PR-3/PR-4 stream-identity contract extended to the robust
     layer: a corruption+quarantine chaos run killed by a planned crash
     and resumed yields the uninterrupted twin's stream — quarantine,
-    update_norm, and quarantined-comm records included."""
+    update_norm, and quarantined-comm records included. Slow tier (three
+    trainer runs): the CORE crash-resume identity stays tier-1 in
+    test_obs.py/test_fold_eval.py; this variant adds the robust-layer
+    records and rides tier-2 with the hetero/cohort variants."""
     from federated_pytorch_test_tpu.fault import InjectedCrash
 
     def cfgq(tag, plan):
