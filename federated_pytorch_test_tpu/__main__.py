@@ -64,10 +64,24 @@ Observability (obs/, docs/OBSERVABILITY.md) rides it too:
 JSONL file that `--resume auto` continues seamlessly, `--trace-out
 run.trace.json` writes the host loop nest as Chrome trace-event JSON
 (open in https://ui.perfetto.dev), `--diagnostics-every N` samples the
-cross-client `group_distance` diagnostic, and every run ends with a
-summary table: per-series record counts, exact communicated bytes vs the
-full-model-exchange and ship-the-data baselines, dispatch and recompile
-counts.
+cross-client `group_distance` diagnostic, the in-run health engine
+(`--no-health-monitor` to disable, `--health-window N` for the anomaly
+window) distills every round into a `health` record plus `health:*`
+trace instants, and every run ends with a summary table: per-series
+record counts, exact communicated bytes vs the full-model-exchange and
+ship-the-data baselines, dispatch and recompile counts, and the health
+verdict.
+
+Cross-run analysis is its own verb (obs/registry.py — pure host-side
+file analysis, no accelerator backend init, so it runs on any host):
+
+    python -m federated_pytorch_test_tpu report runs/ --json report.json
+
+ingests a directory of `--metrics-stream` files (validating each header
+like resume does, refusing foreign streams), aligns the runs on round
+index, and emits comparison tables plus the convergence-vs-bytes
+frontier (accuracy vs cumulative `comm_bytes` per run) as JSON and
+markdown — a codec/combiner/deadline sweep becomes one command.
 """
 
 from __future__ import annotations
@@ -77,16 +91,11 @@ import dataclasses
 import json
 import sys
 
-from federated_pytorch_test_tpu.engine import (
-    PRESETS,
-    ExperimentConfig,
-    get_preset,
-    run_experiment,
-)
-
 
 def _add_config_flags(parser: argparse.ArgumentParser) -> None:
     """One flag per `ExperimentConfig` field (booleans get --x/--no-x)."""
+    from federated_pytorch_test_tpu.engine import ExperimentConfig
+
     for f in dataclasses.fields(ExperimentConfig):
         flag = "--" + f.name.replace("_", "-")
         if f.type in ("bool", bool):
@@ -172,6 +181,34 @@ def _print_summary(recorder, cfg) -> None:
             f"# dispatches: {disp.get('total', 0)} ({per_cat}); "
             f"compiled programs: {recompiles}"
         )
+    health = recorder.series.get("health", [])
+    if health:
+        anomalies = sum(len(r["value"].get("anomalies", ())) for r in health)
+        last = health[-1]["value"]
+        line = (
+            f"# health: {len(health)} rounds monitored, "
+            f"{anomalies} anomalies"
+        )
+        tl = last.get("train_loss")
+        if tl:
+            line += f"; loss p50={tl['p50']:g} p95={tl['p95']:g}"
+        ct = last.get("client_time")
+        if ct:
+            # the online tail estimate item 4's learned deadlines consume
+            line += f"; client_time p95~{ct['p50']:g}s"
+        print(line)
+    roof = recorder.latest("roofline")
+    if roof is not None:
+        line = f"# roofline: wall {roof['wall_s']}s/round"
+        if "arithmetic_intensity" in roof:
+            line += f", intensity {roof['arithmetic_intensity']}"
+        if "mfu" in roof:
+            line += f", MFU {roof['mfu']}"
+        if "achieved_hbm_frac" in roof:
+            line += f", HBM {roof['achieved_hbm_frac']} of peak"
+        if "bound" in roof:
+            line += f" ({roof['bound']}-bound)"
+        print(line)
     if cfg.metrics_stream:
         print(f"# metric stream: {cfg.metrics_stream}")
     if cfg.trace_out:
@@ -184,6 +221,24 @@ def _print_summary(recorder, cfg) -> None:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "report":
+        # the cross-run registry verb (obs/registry.py): dispatched
+        # before the engine import chain so `report` never initializes
+        # an accelerator backend — it runs on hosts whose TPU runtime
+        # is absent or would block on init
+        from federated_pytorch_test_tpu.obs.registry import report_main
+
+        return report_main(argv[1:])
+
+    from federated_pytorch_test_tpu.engine import (
+        PRESETS,
+        ExperimentConfig,
+        get_preset,
+        run_experiment,
+    )
+
     parser = argparse.ArgumentParser(
         prog="federated_pytorch_test_tpu",
         description="TPU-native federated / consensus optimization experiments",

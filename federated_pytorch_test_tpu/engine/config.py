@@ -299,6 +299,20 @@ class ExperimentConfig:
     # never-called distance_of_layers, given a cadence). None = off; the
     # diagnostic is one extra tiny jitted dispatch per sampled round.
     diagnostics_every: int | None = None
+    # in-run health engine (obs/health.py HealthEngine): streaming
+    # P²-style percentile sketches over train loss / update norms /
+    # client-time tails plus a windowed anomaly monitor, emitting one
+    # `health` record per partition round and `health:*` trace instants.
+    # Pure host bookkeeping over values the trainer already fetched —
+    # ZERO extra device dispatches (the folded round stays
+    # {round: 1, round_init: 1}) — and replay-identical across
+    # crash+resume. ANALYSIS-ONLY knobs: never trajectory-changing, so
+    # both are excluded from the metrics-stream header tag (a resumed
+    # run may flip them and still splice — Trainer._stream_tag).
+    health_monitor: bool = True
+    # completed partition rounds in the monitor's anomaly window (rates,
+    # loss explosion/plateau detection)
+    health_window: int = 8
 
     # failure detection (SURVEY.md §5 — absent in the reference): check
     # per-client losses each epoch and per-client parameter finiteness
@@ -530,6 +544,10 @@ class ExperimentConfig:
         if self.diagnostics_every is not None and self.diagnostics_every < 1:
             raise ValueError(
                 f"diagnostics_every must be >= 1, got {self.diagnostics_every}"
+            )
+        if self.health_window < 1:
+            raise ValueError(
+                f"health_window must be >= 1, got {self.health_window}"
             )
         if self.robust_agg not in ROBUST_METHODS:
             raise ValueError(

@@ -1,6 +1,6 @@
-"""Observability: streaming sinks, the communication ledger, trace export.
+"""Observability: sinks, comm ledger, traces, health, roofline, registry.
 
-Three pillars over the structured metric store (`utils/metrics.py`):
+Six pillars over the structured metric store (`utils/metrics.py`):
 
 * `JsonlSink` — a crash-safe append-only JSONL metric stream with
   per-outer-loop commit markers; `resume='auto'` replays it and truncates
@@ -12,16 +12,59 @@ Three pillars over the structured metric store (`utils/metrics.py`):
 * `TraceRecorder` / `DispatchCounter` — host-side span recording exported
   as Chrome trace-event JSON (loadable in Perfetto) plus dispatch- and
   recompile-count series, so fusion regressions show up as metrics
-  (trace.py).
+  (trace.py);
+* `HealthEngine` / `PercentileSketch` — streaming in-run statistics
+  (P²-style online percentile sketches over loss / update norms /
+  client-time tails) and a windowed anomaly monitor emitting a `health`
+  series + `health:*` trace instants, replay-identical across crash and
+  resume (health.py);
+* `lbfgs_round_cost` / `roofline_record` / `chip_peaks` — the analytic
+  per-round cost model and achieved-utilization accounting behind the
+  trainer's, bench.py's, and full_schedule_tpu.py's `roofline` records
+  (roofline.py);
+* `RunRegistry` — the cross-run experiment registry behind the
+  `python -m federated_pytorch_test_tpu report` CLI: validated stream
+  ingestion, round-aligned comparisons, and the convergence-vs-bytes
+  frontier (registry.py).
 """
 
+from federated_pytorch_test_tpu.obs.health import (
+    HealthEngine,
+    P2Quantile,
+    PercentileSketch,
+)
 from federated_pytorch_test_tpu.obs.ledger import CommLedger
+from federated_pytorch_test_tpu.obs.registry import (
+    RunRegistry,
+    StreamRefused,
+    read_stream,
+    render_markdown,
+    report_main,
+)
+from federated_pytorch_test_tpu.obs.roofline import (
+    CHIP_PEAKS,
+    chip_peaks,
+    lbfgs_round_cost,
+    roofline_record,
+)
 from federated_pytorch_test_tpu.obs.sinks import JsonlSink
 from federated_pytorch_test_tpu.obs.trace import DispatchCounter, TraceRecorder
 
 __all__ = [
+    "CHIP_PEAKS",
     "CommLedger",
     "DispatchCounter",
+    "HealthEngine",
     "JsonlSink",
+    "P2Quantile",
+    "PercentileSketch",
+    "RunRegistry",
+    "StreamRefused",
     "TraceRecorder",
+    "chip_peaks",
+    "lbfgs_round_cost",
+    "read_stream",
+    "render_markdown",
+    "report_main",
+    "roofline_record",
 ]

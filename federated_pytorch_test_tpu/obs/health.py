@@ -1,0 +1,369 @@
+"""In-run health engine: streaming statistics + anomaly detection.
+
+The raw metric streams (obs/sinks.py) record everything and judge
+nothing: whether a run is *healthy* — losses finite and moving, no
+rollback churn, the quarantine not firing every round, deadlines mostly
+made — was a post-hoc grep until this module. The `HealthEngine` watches
+the same records the JSONL sink receives and distills them, once per
+partition round, into a structured `health` series record plus
+`health:*` trace instants when an anomaly fires.
+
+Two kinds of state, both bounded:
+
+* **P²-style percentile sketches** (`P2Quantile` / `PercentileSketch`,
+  Jain & Chlamtac 1985): online p50/p95/p99 estimates over the
+  `train_loss`, `update_norm`, and `client_time` observations in five
+  markers per quantile — O(1) memory, no array retention, one pass.
+  The `client_time` sketch is the online tail-latency estimate ROADMAP
+  item 4's learned deadlines will consume: it ingests each exchange's
+  cross-client p95 simulated time, so its p50 is a stable "typical p95"
+  deadline signal and its p95 a conservative one.
+* **a windowed round monitor**: per-round counters (non-finite
+  observations, detected faults, rollbacks, quarantined clients,
+  deadline misses, exchanges) kept for the last `window` completed
+  rounds, yielding rates plus loss-explosion / loss-plateau detection
+  against the windowed per-round mean-loss history.
+
+Crash-safety rides the usual resume-stream-identity contract
+(docs/OBSERVABILITY.md): the engine is a PURE function of the streamed
+record sequence — it consumes values exactly as they appear in the JSONL
+stream (floats JSON-round-trip exactly), never wall-clock `t` fields —
+so a resumed run replays the kept records through `replay()` and
+continues with bit-identical internal state: a crashed+resumed run's
+`health` series equals an uninterrupted twin's. The engine does no
+device work at all: every input is a host value the trainer already
+fetched, so enabling it adds zero dispatches (the folded round stays
+`{round: 1, round_init: 1}`).
+
+The knobs (`health_monitor`, `health_window`) are analysis-only — they
+never change the training trajectory — so they are excluded from the
+metrics-stream header tag: a resumed run may flip them and still splice
+(engine/trainer.py `_stream_tag`).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Iterable, List, Optional, Tuple
+
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _quantile_key(q: float) -> str:
+    """0.5 -> 'p50', 0.95 -> 'p95', 0.99 -> 'p99' (no trailing zeros)."""
+    s = f"{100.0 * q:g}"
+    return "p" + s.replace(".", "_")
+
+
+class P2Quantile:
+    """One quantile, estimated online with the P² algorithm.
+
+    Five markers (min, three interior, max) adjusted per observation by
+    parabolic (fallback linear) interpolation toward their desired
+    positions — O(1) memory and update cost. Exact for the first five
+    observations (sorted-buffer interpolation); thereafter an estimate
+    whose rank error the sketch tests bound against numpy on adversarial
+    sequences (tests/test_health.py). Non-finite observations are
+    ignored (a NaN marker height would poison every later estimate).
+    """
+
+    __slots__ = ("q", "count", "_init", "_h", "_n", "_np", "_dn")
+
+    def __init__(self, q: float):
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {q}")
+        self.q = float(q)
+        self.count = 0
+        self._init: List[float] = []
+        self._h: Optional[List[float]] = None  # marker heights
+        self._n: Optional[List[float]] = None  # marker positions (1-based)
+        self._np: Optional[List[float]] = None  # desired positions
+        self._dn: Optional[List[float]] = None  # desired-position increments
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        if not math.isfinite(x):
+            return
+        self.count += 1
+        if self._h is None:
+            self._init.append(x)
+            if len(self._init) == 5:
+                self._init.sort()
+                q = self.q
+                self._h = list(self._init)
+                self._n = [1.0, 2.0, 3.0, 4.0, 5.0]
+                self._np = [1.0, 1 + 2 * q, 1 + 4 * q, 3 + 2 * q, 5.0]
+                self._dn = [0.0, q / 2, q, (1 + q) / 2, 1.0]
+            return
+        h, n, np_, dn = self._h, self._n, self._np, self._dn
+        if x < h[0]:
+            h[0] = x
+            k = 0
+        elif x >= h[4]:
+            h[4] = x
+            k = 3
+        else:
+            k = 3
+            for i in range(4):
+                if h[i] <= x < h[i + 1]:
+                    k = i
+                    break
+        for i in range(k + 1, 5):
+            n[i] += 1.0
+        for i in range(5):
+            np_[i] += dn[i]
+        for i in (1, 2, 3):
+            d = np_[i] - n[i]
+            if (d >= 1.0 and n[i + 1] - n[i] > 1.0) or (
+                d <= -1.0 and n[i - 1] - n[i] < -1.0
+            ):
+                s = 1.0 if d >= 1.0 else -1.0
+                si = int(s)
+                hp = h[i] + s / (n[i + 1] - n[i - 1]) * (
+                    (n[i] - n[i - 1] + s) * (h[i + 1] - h[i]) / (n[i + 1] - n[i])
+                    + (n[i + 1] - n[i] - s) * (h[i] - h[i - 1]) / (n[i] - n[i - 1])
+                )
+                if not (h[i - 1] < hp < h[i + 1]):
+                    # parabolic prediction left the bracket: linear step
+                    hp = h[i] + s * (h[i + si] - h[i]) / (n[i + si] - n[i])
+                h[i] = hp
+                n[i] += s
+
+    def value(self) -> Optional[float]:
+        if self.count == 0:
+            return None
+        if self._h is None:
+            xs = sorted(self._init)
+            pos = self.q * (len(xs) - 1)
+            lo = int(math.floor(pos))
+            hi = min(lo + 1, len(xs) - 1)
+            return xs[lo] + (pos - lo) * (xs[hi] - xs[lo])
+        return self._h[2]
+
+
+class PercentileSketch:
+    """A bundle of `P2Quantile`s over one observation stream."""
+
+    def __init__(self, quantiles: Iterable[float] = DEFAULT_QUANTILES):
+        self.quantiles = tuple(float(q) for q in quantiles)
+        self._est = [P2Quantile(q) for q in self.quantiles]
+
+    def update(self, x: float) -> None:
+        try:
+            x = float(x)
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(x):
+            return
+        for e in self._est:
+            e.update(x)
+
+    @property
+    def count(self) -> int:
+        return self._est[0].count if self._est else 0
+
+    def estimates(self, ndigits: int = 6) -> Optional[dict]:
+        """`{"p50": ..., "p95": ..., "p99": ..., "n": count}` or None
+        while empty. Rounded for record compactness — rounding is
+        deterministic, so twin streams stay identical."""
+        if self.count == 0:
+            return None
+        out = {
+            _quantile_key(q): round(float(e.value()), ndigits)
+            for q, e in zip(self.quantiles, self._est)
+        }
+        out["n"] = self.count
+        return out
+
+
+def _median(xs: List[float]) -> float:
+    ys = sorted(xs)
+    m = len(ys) // 2
+    return ys[m] if len(ys) % 2 else 0.5 * (ys[m - 1] + ys[m])
+
+
+# per-round counter template (one dict per partition round)
+_ROUND_KEYS = (
+    "nonfinite", "faults", "rollbacks", "quarantined", "deadline_missed",
+)
+
+
+class HealthEngine:
+    """Streaming in-run health: sketches + windowed anomaly monitor.
+
+    Wiring (engine/trainer.py): the engine sits on
+    `MetricsRecorder.observers` and receives every STREAMED record at
+    log time via `observe(name, rec)` — exactly the records (and order)
+    the JSONL sink persists, which is what makes `replay()` reconstruct
+    identical state on resume. At each partition-round boundary the
+    trainer calls `round_record()` for the `health` record value and the
+    round's anomaly list (emitted as `health:<kind>` trace instants),
+    which also advances the round window.
+
+    On `resume='auto'` the trainer feeds the sink's replayed records
+    through `replay()` BEFORE attaching the observer: raw records
+    re-update the sketches/counters and each replayed `health` record
+    advances the window, so the resumed engine's state equals the
+    crashed process's at the truncation point. Without a metrics stream
+    a resumed engine starts cold (like the quarantine scoreboard, the
+    windowed history is resume-proof only via a replayed stream).
+    """
+
+    def __init__(
+        self,
+        window: int = 8,
+        explode_factor: float = 10.0,
+        plateau_rtol: float = 1e-3,
+        quantiles: Iterable[float] = DEFAULT_QUANTILES,
+    ):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.explode_factor = float(explode_factor)
+        self.plateau_rtol = float(plateau_rtol)
+        self.loss = PercentileSketch(quantiles)
+        self.update_norm = PercentileSketch(quantiles)
+        self.client_time = PercentileSketch(quantiles)
+        self.rounds = 0  # completed (advanced-past) rounds
+        self.anomalies_total = 0
+        # completed rounds' counter dicts (plus per-round "loss_mean")
+        self._win: collections.deque = collections.deque(maxlen=self.window)
+        self._cur = self._blank()
+
+    @staticmethod
+    def _blank() -> dict:
+        d = {k: 0 for k in _ROUND_KEYS}
+        d["loss_sum"] = 0.0
+        d["loss_n"] = 0
+        return d
+
+    # ------------------------------------------------------------ ingestion
+
+    def observe(self, name: str, rec: dict) -> None:
+        """One streamed record, at log (or replay) time. Pure in the
+        record sequence; `t` and other wall-clock fields are never read.
+        Series the engine does not understand — including its own
+        `health` records (the replay path segments on those instead) —
+        are ignored."""
+        v = rec.get("value")
+        if name == "train_loss" and isinstance(v, (list, tuple)):
+            cur = self._cur
+            for x in v:
+                try:
+                    x = float(x)
+                except (TypeError, ValueError):
+                    continue
+                if math.isfinite(x):
+                    self.loss.update(x)
+                    cur["loss_sum"] += x
+                    cur["loss_n"] += 1
+                else:
+                    cur["nonfinite"] += 1
+        elif name == "update_norm" and isinstance(v, (list, tuple)):
+            for x in v:
+                if x is None:
+                    # a null norm marks a non-finite (corrupted) update
+                    # (utils/metrics.py update_norms)
+                    self._cur["nonfinite"] += 1
+                else:
+                    self.update_norm.update(x)
+        elif name == "client_time" and isinstance(v, dict):
+            p95 = v.get("p95")
+            if p95 is not None:
+                self.client_time.update(p95)
+        elif name == "fault" and isinstance(v, dict):
+            kind = v.get("kind")
+            if kind == "round_rollback":
+                self._cur["rollbacks"] += 1
+            else:
+                self._cur["faults"] += 1
+        elif name == "quarantine" and isinstance(v, dict):
+            self._cur["quarantined"] += len(v.get("clients", ()))
+        elif name == "deadline_miss" and isinstance(v, dict):
+            self._cur["deadline_missed"] += len(v.get("clients", ()))
+
+    def replay(self, records: Iterable[Tuple[str, dict]]) -> None:
+        """Rebuild state from a resumed stream's replayed records
+        (obs/sinks.py `open(resume_nloops=...)` output, in stream
+        order). Raw records re-ingest; each replayed `health` record
+        marks a completed round and advances the window exactly as the
+        live `round_record()` did when it was written."""
+        for name, rec in records:
+            if name == "health":
+                v = rec.get("value")
+                if isinstance(v, dict):
+                    self.anomalies_total += len(v.get("anomalies", ()))
+                self._advance()
+            else:
+                self.observe(name, rec)
+
+    # ------------------------------------------------------- round boundary
+
+    def _advance(self) -> None:
+        cur = self._cur
+        cur["loss_mean"] = (
+            cur["loss_sum"] / cur["loss_n"] if cur["loss_n"] else None
+        )
+        self._win.append(cur)
+        self._cur = self._blank()
+        self.rounds += 1
+
+    def round_record(self) -> Tuple[dict, List[str]]:
+        """Close the current partition round: returns `(value,
+        anomalies)` — the `health` record value plus the round's anomaly
+        kinds — and advances the window. Deterministic in the observed
+        record sequence (twin runs emit identical values)."""
+        cur = self._cur
+        mean_loss = cur["loss_sum"] / cur["loss_n"] if cur["loss_n"] else None
+        prev_means = [
+            r["loss_mean"] for r in self._win if r["loss_mean"] is not None
+        ]
+
+        anomalies: List[str] = []
+        if cur["nonfinite"] or cur["faults"]:
+            anomalies.append("nonfinite")
+        if cur["rollbacks"]:
+            anomalies.append("rollback")
+        if mean_loss is not None and prev_means:
+            med = _median(prev_means)
+            if med > 0 and mean_loss > self.explode_factor * med:
+                anomalies.append("loss_explosion")
+        means = prev_means + ([mean_loss] if mean_loss is not None else [])
+        if len(means) >= self.window + 1:
+            spread = max(means) - min(means)
+            scale = max(abs(_median(means)), 1e-12)
+            if spread <= self.plateau_rtol * scale:
+                anomalies.append("loss_plateau")
+
+        rounds_w = list(self._win) + [cur]
+        n = len(rounds_w)
+
+        def rate(key: str) -> float:
+            return round(sum(r[key] for r in rounds_w) / n, 6)
+
+        window = {
+            "rounds": n,
+            "nonfinite_rate": rate("nonfinite"),
+            "fault_rate": rate("faults"),
+            "rollback_rate": rate("rollbacks"),
+            "quarantine_rate": rate("quarantined"),
+            "deadline_miss_rate": rate("deadline_missed"),
+            "loss_mean": (
+                round(mean_loss, 6) if mean_loss is not None else None
+            ),
+        }
+        value: dict = {
+            "round": self.rounds,
+            "anomalies": anomalies,
+            "window": window,
+        }
+        if self.loss.count:
+            value["train_loss"] = self.loss.estimates()
+        if self.update_norm.count:
+            value["update_norm"] = self.update_norm.estimates()
+        if self.client_time.count:
+            value["client_time"] = self.client_time.estimates()
+        self.anomalies_total += len(anomalies)
+        self._advance()
+        return value, anomalies

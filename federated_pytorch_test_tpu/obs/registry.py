@@ -1,0 +1,361 @@
+"""Cross-run experiment registry + the `report` CLI verb.
+
+A codec / combiner / deadline sweep produces a directory of JSONL metric
+streams (obs/sinks.py, one per run). Comparing them used to be ad-hoc jq
+— in particular ROADMAP item 3's convergence-vs-bytes frontier (accuracy
+against cumulative `comm_bytes` per run) had no tooling at all. The
+registry ingests such a directory, validates every stream, aligns the
+runs on round index, and emits comparison tables plus the frontier as
+JSON and markdown:
+
+    python -m federated_pytorch_test_tpu report runs/ --json report.json
+
+Validation mirrors the resume path's stream checks (obs/sinks.py
+`_scan`): a file whose first parsable line is not a `stream_header`, or
+whose header version is unsupported, is REFUSED (skipped with a warning
+in directory mode) rather than half-parsed — splicing a foreign file
+into a comparison would be worse than dropping it. Within an accepted
+stream the same tolerance applies: a torn final line (crash mid-write)
+is dropped, and nothing past the first unparsable line is trusted.
+`--match SUBSTR` additionally refuses streams whose header tag does not
+contain the substring (the registry-side analogue of the resume tag
+check, for directories that mix experiments).
+
+Determinism contract: the report is a pure function of the streams'
+RECORD CONTENT — never wall-clock `t` fields, `step_time` seconds, or
+the raw header tag (crashed+resumed twins legitimately differ in all
+three). Runs are keyed by file stem and labeled by the tag's
+`<preset>:seed<N>` prefix, so a crashed+resumed run's report is
+byte-identical to its uninterrupted twin's (the tier-2 `report_smoke`
+gate, scripts/ci.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+from federated_pytorch_test_tpu.obs.sinks import STREAM_VERSION
+
+REPORT_VERSION = 1
+
+
+class StreamRefused(ValueError):
+    """A file the registry will not treat as a metric stream (missing or
+    foreign header, unsupported version, tag filter mismatch)."""
+
+
+class RunStream:
+    """One ingested metric stream: header identity + parsed records."""
+
+    def __init__(self, name: str, tag: str, path: str):
+        self.name = name
+        self.tag = tag
+        self.path = path
+        # the stable cross-twin label: '<preset>:seed<N>' (the config/plan
+        # digests that follow legitimately differ between a crashed run
+        # and its uninterrupted twin)
+        self.label = ":".join(tag.split(":")[:2]) if tag else ""
+        self.records: List[Tuple[str, dict]] = []  # (series, record)
+        self.markers: List[int] = []  # nloop_complete values, in order
+
+
+def read_stream(path: str, name: Optional[str] = None) -> RunStream:
+    """Parse one JSONL metric stream; raises `StreamRefused` if the file
+    does not open with a valid same-version `stream_header`."""
+    with open(path, "rb") as f:
+        data = f.read()
+    run = None
+    for raw in data.splitlines(keepends=True):
+        if not raw.endswith(b"\n"):
+            break  # torn tail from a crash mid-write
+        try:
+            d = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            break  # nothing past an unparsable line is trustworthy
+        if run is None:
+            if d.get("event") != "stream_header":
+                raise StreamRefused(
+                    f"{path}: first line is not a stream_header — not a "
+                    "metric stream"
+                )
+            if d.get("version") != STREAM_VERSION:
+                raise StreamRefused(
+                    f"{path}: stream version {d.get('version')!r} != "
+                    f"{STREAM_VERSION} — refusing to misread a foreign "
+                    "format"
+                )
+            run = RunStream(
+                name or os.path.splitext(os.path.basename(path))[0],
+                str(d.get("tag", "")),
+                path,
+            )
+            continue
+        if d.get("event") == "nloop_complete":
+            run.markers.append(int(d.get("nloop", -1)))
+        elif "series" in d:
+            series = d.pop("series")
+            run.records.append((series, d))
+    if run is None:
+        raise StreamRefused(f"{path}: empty or unparsable file")
+    return run
+
+
+def _mean(xs) -> Optional[float]:
+    xs = [float(x) for x in xs]
+    return sum(xs) / len(xs) if xs else None
+
+
+class RunRegistry:
+    """Ingests validated metric streams and produces the cross-run
+    report (see module docstring)."""
+
+    def __init__(self, match: Optional[str] = None):
+        self.match = match
+        self.runs: Dict[str, RunStream] = {}
+
+    def ingest(self, path: str, name: Optional[str] = None) -> RunStream:
+        run = read_stream(path, name=name)
+        if self.match and self.match not in run.tag:
+            raise StreamRefused(
+                f"{path}: header tag {run.tag!r} does not match "
+                f"{self.match!r} — foreign experiment refused"
+            )
+        if run.name in self.runs:
+            raise StreamRefused(
+                f"{path}: run name {run.name!r} already ingested "
+                f"(from {self.runs[run.name].path})"
+            )
+        self.runs[run.name] = run
+        return run
+
+    def ingest_dir(self, d: str, pattern: str = "*.jsonl") -> List[str]:
+        """Ingest every matching stream under `d`; refused files are
+        skipped with a warning. Returns the skipped paths."""
+        skipped = []
+        for path in sorted(_glob.glob(os.path.join(d, pattern))):
+            try:
+                self.ingest(path)
+            except StreamRefused as e:
+                warnings.warn(str(e))
+                skipped.append(path)
+        return skipped
+
+    # ------------------------------------------------------------- analysis
+
+    @staticmethod
+    def _run_summary(run: RunStream) -> dict:
+        cum_bytes = 0
+        curve: List[dict] = []
+        comm_summary = None
+        health_records = 0
+        health_anomalies = 0
+        health_last = None
+        exchanges = 0
+        for series, rec in run.records:
+            if series == "comm_bytes":
+                cum_bytes += int(rec["value"])
+                exchanges += 1
+            elif series == "test_accuracy":
+                acc = _mean(rec["value"])
+                curve.append(
+                    {
+                        "eval": len(curve),
+                        "nloop": rec.get("nloop"),
+                        "group": rec.get("group"),
+                        "nadmm": rec.get("nadmm"),
+                        "cum_bytes": cum_bytes,
+                        "accuracy": round(acc, 6) if acc is not None else None,
+                    }
+                )
+            elif series == "comm_summary":
+                comm_summary = rec["value"]
+            elif series == "health":
+                health_records += 1
+                v = rec.get("value")
+                if isinstance(v, dict):
+                    health_anomalies += len(v.get("anomalies", ()))
+                    health_last = v
+        final_acc = curve[-1]["accuracy"] if curve else None
+        summary: dict = {
+            "experiment": run.label,
+            "stream": {
+                "records": len(run.records),
+                "markers": len(run.markers),
+            },
+            "exchanges": exchanges,
+            "evals": len(curve),
+            "final_accuracy": final_acc,
+            "total_comm_bytes": cum_bytes,
+            "curve": curve,
+        }
+        if comm_summary is not None:
+            summary["comm"] = {
+                k: comm_summary.get(k)
+                for k in (
+                    "exchange_dtype", "wire_bytes_per_value",
+                    "bytes_per_round_mean", "savings_vs_full",
+                )
+            }
+        summary["health"] = {
+            "records": health_records,
+            "anomalies": health_anomalies,
+            "final_window": (
+                health_last.get("window") if health_last else None
+            ),
+        }
+        return summary
+
+    def report(self) -> dict:
+        """The full cross-run document: per-run summaries + curves,
+        round-aligned comparison series, and the convergence-vs-bytes
+        frontier. Deterministic (runs sorted by name, no wall-clock
+        content) — twin directories produce byte-identical output."""
+        if not self.runs:
+            raise ValueError("no runs ingested")
+        runs = {
+            name: self._run_summary(run)
+            for name, run in sorted(self.runs.items())
+        }
+        aligned_acc = {
+            name: [p["accuracy"] for p in s["curve"]]
+            for name, s in runs.items()
+        }
+        aligned_bytes = {
+            name: [p["cum_bytes"] for p in s["curve"]]
+            for name, s in runs.items()
+        }
+        # final-point Pareto frontier over (total bytes ↓, accuracy ↑):
+        # a run is dominated if another reaches >= accuracy with <= bytes
+        # (strictly better on at least one axis)
+        points = [
+            (name, s["total_comm_bytes"], s["final_accuracy"])
+            for name, s in runs.items()
+        ]
+        frontier = []
+
+        def _acc(a):
+            return a if a is not None else -1.0
+
+        for name, b, a in sorted(points, key=lambda p: (p[1], p[0])):
+            dominated = any(
+                other != name
+                and ob <= b
+                and _acc(oa) >= _acc(a)
+                and (ob < b or _acc(oa) > _acc(a))
+                for other, ob, oa in points
+            )
+            frontier.append(
+                {
+                    "run": name,
+                    "total_comm_bytes": b,
+                    "final_accuracy": a,
+                    "pareto": not dominated,
+                }
+            )
+        return {
+            "report_version": REPORT_VERSION,
+            "runs": runs,
+            "aligned": {
+                "accuracy_by_eval": aligned_acc,
+                "cum_bytes_by_eval": aligned_bytes,
+            },
+            "frontier": frontier,
+        }
+
+
+def render_markdown(doc: dict) -> str:
+    """The report document as a compact markdown digest."""
+    lines = ["# Experiment report", "", "## Runs", ""]
+    lines.append(
+        "| run | experiment | evals | final acc | comm bytes | "
+        "exchanges | health anomalies |"
+    )
+    lines.append("|---|---|---|---|---|---|---|")
+    for name, s in doc["runs"].items():
+        acc = (
+            f"{s['final_accuracy']:.4f}"
+            if s["final_accuracy"] is not None
+            else "-"
+        )
+        lines.append(
+            f"| {name} | {s['experiment']} | {s['evals']} | {acc} "
+            f"| {s['total_comm_bytes']:,} | {s['exchanges']} "
+            f"| {s['health']['anomalies']} |"
+        )
+    lines += ["", "## Convergence vs bytes frontier", ""]
+    lines.append("| run | total comm bytes | final acc | pareto |")
+    lines.append("|---|---|---|---|")
+    for p in doc["frontier"]:
+        acc = (
+            f"{p['final_accuracy']:.4f}"
+            if p["final_accuracy"] is not None
+            else "-"
+        )
+        star = "*" if p["pareto"] else ""
+        lines.append(
+            f"| {p['run']} | {p['total_comm_bytes']:,} | {acc} | {star} |"
+        )
+    lines.append("")
+    lines.append(
+        "`*` = on the frontier: no other run reached at least this "
+        "accuracy with at most these bytes."
+    )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def report_main(argv=None) -> int:
+    """`python -m federated_pytorch_test_tpu report <dir>` — pure
+    host-side file analysis: no accelerator backend is ever
+    initialized, so it is safe on hosts whose TPU runtime is absent
+    (or would block on init)."""
+    ap = argparse.ArgumentParser(
+        prog="federated_pytorch_test_tpu report",
+        description=(
+            "Cross-run comparison over a directory of JSONL metric "
+            "streams: per-run tables, round-aligned series, and the "
+            "convergence-vs-bytes frontier (docs/OBSERVABILITY.md)."
+        ),
+    )
+    ap.add_argument("dir", help="directory of --metrics-stream JSONL files")
+    ap.add_argument(
+        "--glob", default="*.jsonl", help="stream filename pattern"
+    )
+    ap.add_argument(
+        "--match",
+        default=None,
+        help="refuse streams whose header tag lacks this substring "
+        "(e.g. 'fedavg:seed0' to pin one experiment family)",
+    )
+    ap.add_argument("--json", default=None, help="write the JSON report here")
+    ap.add_argument("--md", default=None, help="write the markdown here")
+    ap.add_argument(
+        "--quiet", action="store_true", help="suppress the stdout markdown"
+    )
+    args = ap.parse_args(argv)
+
+    reg = RunRegistry(match=args.match)
+    skipped = reg.ingest_dir(args.dir, pattern=args.glob)
+    if not reg.runs:
+        print(
+            f"report: no valid metric streams under {args.dir!r} "
+            f"(pattern {args.glob!r}; {len(skipped)} file(s) refused)"
+        )
+        return 1
+    doc = reg.report()
+    md = render_markdown(doc)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(md)
+    if not args.quiet:
+        print(md, end="")
+    return 0

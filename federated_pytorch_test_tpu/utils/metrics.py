@@ -89,6 +89,13 @@ class MetricsRecorder:
     # streaming sinks (obs/sinks.py protocol: record/flush/commit/close)
     # and the optional trace-span recorder (obs/trace.py TraceRecorder)
     sinks: List[Any] = dataclasses.field(default_factory=list)
+    # synchronous observers (obs/health.py HealthEngine protocol:
+    # observe(name, rec)): called at LOG time for every STREAMED record —
+    # the exact record set (and order) the sinks persist, which is what
+    # lets a resumed observer rebuild identical state from a stream
+    # replay. Unlike sinks, observers see a deferred record BEFORE its
+    # value is materialized (they must ignore Deferred-valued series).
+    observers: List[Any] = dataclasses.field(default_factory=list)
     tracer: Optional[Any] = None
     _t0: float = dataclasses.field(default_factory=time.perf_counter)
     # streamed records not yet forwarded to the sinks: a `Deferred` value
@@ -109,6 +116,8 @@ class MetricsRecorder:
         rec = {"t": time.perf_counter() - self._t0, "value": value, **context}
         self.series.setdefault(name, []).append(rec)
         if stream:
+            for ob in self.observers:
+                ob.observe(name, rec)
             if self._pending or isinstance(value, Deferred):
                 self._pending.append((name, rec))
             else:

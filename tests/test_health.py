@@ -1,0 +1,343 @@
+"""In-run health engine tests (obs/health.py, docs/OBSERVABILITY.md).
+
+Smoke tier: P² percentile sketches against exact numpy percentiles on
+adversarial sequences (sorted, reversed, constant, heavy-tailed — the
+ISSUE-10 coverage list), engine anomaly detection on synthetic record
+sequences, and the replay-identity mechanics the crash/resume contract
+rides on.
+
+Middle (default) tier: the trainer-level contracts — a `health` record
+per partition round with ZERO extra device dispatches (the folded round
+stays `{round: 1, round_init: 1}`), the record reaching the JSONL
+stream, and the stream-tag hygiene satellite: the analysis-only health
+knobs are OUT of the header tag, so a resumed run that flips them still
+splices (the splice-ACCEPTED regression beside the refused-splice ones
+in tests/test_exchange.py). The full crashed+resumed-equals-twin stream
+identity — now including `health` records — stays where it lives:
+tests/test_obs.py::test_metrics_stream_crash_resume_identical.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.obs import (
+    HealthEngine,
+    P2Quantile,
+    PercentileSketch,
+)
+
+smoke = pytest.mark.smoke
+
+
+# ------------------------------------------------------------ P² sketches
+
+
+def _adversarial_sequences():
+    rng = np.random.default_rng(7)
+    return {
+        "sorted": np.arange(1.0, 1001.0),
+        "reversed": np.arange(1000.0, 0.0, -1.0),
+        "constant": np.full(500, 3.25),
+        # Pareto(α=2): the heavy tail where naive estimators smear
+        "heavy_tailed": rng.pareto(2.0, 2000) + 1.0,
+    }
+
+
+@smoke
+@pytest.mark.parametrize("q", [0.5, 0.95, 0.99])
+def test_p2_sketch_tracks_numpy_percentiles(q):
+    """The P² estimate must land inside the exact value envelope of the
+    nearby ranks (±3 percent points) on every adversarial sequence —
+    rank-error bounded, the honest accuracy claim for a 5-marker
+    sketch."""
+    for name, xs in _adversarial_sequences().items():
+        p = P2Quantile(q)
+        for x in xs:
+            p.update(float(x))
+        assert p.count == len(xs)
+        lo, hi = np.percentile(
+            xs, [max(0.0, q - 0.03) * 100, min(1.0, q + 0.03) * 100]
+        )
+        assert lo <= p.value() <= hi, (name, q, p.value(), (lo, hi))
+
+
+@smoke
+def test_p2_sketch_exact_below_five_and_ignores_nonfinite():
+    p = P2Quantile(0.5)
+    assert p.value() is None
+    for x in (5.0, float("nan"), 1.0, float("inf"), 3.0):
+        p.update(x)
+    # non-finite observations never enter (a NaN marker would poison
+    # every later estimate); <5 observations interpolate exactly
+    assert p.count == 3
+    assert p.value() == 3.0
+
+    s = PercentileSketch()
+    assert s.estimates() is None
+    for x in range(1, 101):
+        s.update(x)
+    est = s.estimates()
+    assert est["n"] == 100
+    assert est["p50"] == pytest.approx(np.percentile(range(1, 101), 50), rel=0.05)
+    assert set(est) == {"p50", "p95", "p99", "n"}
+
+
+@smoke
+def test_p2_rejects_degenerate_quantiles():
+    with pytest.raises(ValueError):
+        P2Quantile(0.0)
+    with pytest.raises(ValueError):
+        P2Quantile(1.0)
+    with pytest.raises(ValueError):
+        HealthEngine(window=0)
+
+
+# ------------------------------------------------------- engine mechanics
+
+
+def _round_records(loss, *, norms=None, times=None, extra=()):
+    """One synthetic round's streamed records (the engine's input set)."""
+    recs = [("train_loss", {"t": 0.0, "value": list(loss), "nloop": 0})]
+    if norms is not None:
+        recs.append(("update_norm", {"t": 0.0, "value": list(norms)}))
+    if times is not None:
+        recs.append(("client_time", {"t": 0.0, "value": dict(times)}))
+    recs.append(("comm_bytes", {"t": 0.0, "value": 100, "survivors": 3}))
+    recs.extend(extra)
+    return recs
+
+
+def _run_round(engine, records):
+    for name, rec in records:
+        engine.observe(name, rec)
+    return engine.round_record()
+
+
+@smoke
+def test_engine_counters_sketches_and_window_rates():
+    eng = HealthEngine(window=4)
+    val, anomalies = _run_round(
+        eng,
+        _round_records(
+            [1.0, 2.0, float("nan")],
+            norms=[0.5, None, 1.5],
+            times={"p50": 1.0, "p95": 2.0, "p99": 2.2, "max": 2.5, "round": 2.5},
+            extra=[
+                ("quarantine", {"t": 0.0, "value": {"clients": [2]}}),
+                ("deadline_miss", {"t": 0.0, "value": {"clients": [0, 2]}}),
+                ("fault", {"t": 0.0, "value": {"kind": "nonfinite_loss",
+                                               "clients": [2]}}),
+            ],
+        ),
+    )
+    assert anomalies == ["nonfinite"]
+    w = val["window"]
+    assert w["rounds"] == 1
+    # 1 NaN loss entry + 1 null norm = 2 non-finite observations
+    assert w["nonfinite_rate"] == 2.0
+    assert w["fault_rate"] == 1.0
+    assert w["quarantine_rate"] == 1.0
+    assert w["deadline_miss_rate"] == 2.0
+    assert w["loss_mean"] == pytest.approx(1.5)
+    assert val["train_loss"]["n"] == 2  # finite entries only
+    assert val["update_norm"]["n"] == 2
+    # the deadline signal: sketch over per-exchange cross-client p95s
+    assert val["client_time"]["n"] == 1
+    assert val["round"] == 0
+
+
+@smoke
+def test_engine_loss_explosion_rollback_and_plateau():
+    eng = HealthEngine(window=3, explode_factor=10.0)
+    for _ in range(3):
+        _, an = _run_round(eng, _round_records([1.0, 1.0]))
+        assert an == []
+    # 100x the windowed median: explosion
+    _, an = _run_round(eng, _round_records([100.0, 100.0]))
+    assert "loss_explosion" in an
+    # a rollback fault flags the round
+    _, an = _run_round(
+        eng,
+        _round_records(
+            [1.0, 1.0],
+            extra=[("fault", {"t": 0.0,
+                              "value": {"kind": "round_rollback",
+                                        "clients": []}})],
+        ),
+    )
+    assert "rollback" in an
+
+    flat = HealthEngine(window=3, plateau_rtol=1e-3)
+    an_hist = []
+    for _ in range(5):
+        _, an = _run_round(flat, _round_records([0.7, 0.7]))
+        an_hist.append(an)
+    # plateau needs the window FULL plus the current round (4 means at
+    # window=3), then fires every flat round after
+    assert an_hist[:3] == [[], [], []]
+    assert all("loss_plateau" in an for an in an_hist[3:])
+
+
+@smoke
+def test_engine_replay_rebuilds_identical_state():
+    """The crash/resume mechanism: an engine fed a stream's replayed
+    records (JSON round-tripped, health records marking round
+    boundaries) continues with records identical to the uninterrupted
+    engine's — the health half of the stream-identity contract."""
+    rounds = [
+        _round_records([2.0 - 0.2 * r, 2.1 - 0.2 * r],
+                       norms=[0.1 * (r + 1), 0.2 * (r + 1)])
+        for r in range(6)
+    ]
+    live = HealthEngine(window=3)
+    stream, values = [], []
+    for recs in rounds:
+        stream.extend(recs)
+        for name, rec in recs:
+            live.observe(name, rec)
+        val, _ = live.round_record()
+        stream.append(("health", {"t": 0.0, "value": val}))
+        values.append(val)
+
+    # cut after round 4's health record, JSON round-trip like the sink
+    cut = [i for i, (n, _) in enumerate(stream) if n == "health"][3] + 1
+    replayed = [
+        (n, json.loads(json.dumps(r))) for n, r in stream[:cut]
+    ]
+    resumed = HealthEngine(window=3)
+    resumed.replay(replayed)
+    assert resumed.rounds == 4
+    for r in range(4, 6):
+        for name, rec in rounds[r]:
+            resumed.observe(name, json.loads(json.dumps(rec)))
+        val, _ = resumed.round_record()
+        assert val == values[r], r
+
+
+# ----------------------------------- Trainer integration (middle tier)
+# Unmarked: tier-1 over the same tiny model/config family as
+# tests/test_obs.py so the persistent compile cache amortizes them.
+
+
+@pytest.fixture(scope="module")
+def _src():
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+
+    return synthetic_cifar(n_train=240, n_test=60)
+
+
+def _tiny(**over):
+    from federated_pytorch_test_tpu.engine import get_preset
+
+    base = dict(
+        batch=40, nloop=2, nadmm=2, max_groups=1, model="net",
+        check_results=False, synthetic_ok=True,
+    )
+    base.update(over)
+    return get_preset("fedavg", **base)
+
+
+@pytest.fixture(scope="module")
+def health_run(_src, tmp_path_factory):
+    from federated_pytorch_test_tpu.engine import Trainer
+
+    tmp = tmp_path_factory.mktemp("health")
+    cfg = _tiny(
+        metrics_stream=str(tmp / "m.jsonl"),
+        checkpoint_dir=str(tmp / "ckpt"),
+        save_model=True,  # the splice test below resumes this run
+    )
+    tr = Trainer(cfg, verbose=False, source=_src)
+    tr.run()
+    return tr, cfg, tmp
+
+
+def test_health_series_one_record_per_round_zero_dispatches(health_run):
+    tr, cfg, _ = health_run
+    recs = tr.recorder.series["health"]
+    # one record per partition round, cursor-stamped
+    assert len(recs) == cfg.nloop * 1
+    assert [(r["nloop"], r["group"]) for r in recs] == [
+        (n, tr.group_order[0]) for n in range(cfg.nloop)
+    ]
+    # the ISSUE-10 dispatch gate: sketches/monitor add NO device work —
+    # the folded round still dispatches exactly {round, round_init}
+    d = tr.recorder.series["dispatch_count"][0]["value"]
+    assert d == {"round": 1, "round_init": 1, "total": 2}
+    v = recs[-1]["value"]
+    assert v["anomalies"] == []  # healthy run
+    assert v["train_loss"]["n"] > 0
+    assert v["window"]["rounds"] == min(cfg.nloop, 8 + 1)
+    # loss sketch saw every finite per-client loss entry
+    n_entries = sum(
+        len(r["value"]) for r in tr.recorder.series["train_loss"]
+    )
+    assert v["train_loss"]["n"] == n_entries
+
+
+def test_health_records_reach_the_stream(health_run):
+    _, _, tmp = health_run
+    lines = [json.loads(l) for l in open(tmp / "m.jsonl")]
+    health = [l for l in lines if l.get("series") == "health"]
+    assert len(health) == 2
+    # streamed records carry the full structured value
+    assert {"round", "anomalies", "window", "train_loss"} <= set(
+        health[-1]["value"]
+    )
+
+
+def test_health_splice_accepted_on_resumed_stream(_src, health_run, tmp_path):
+    """The splice-ACCEPTED regression (ISSUE-10 satellite): the
+    analysis-only health knobs must not change the stream identity — a
+    resumed run may flip them and still splice (no fresh-stream
+    warning, the replayed records rebuilding the engine's state),
+    exactly like the dispatch-shape fold/async knobs and unlike the
+    trajectory-changing probes/codec knobs whose refused-splice twins
+    live in tests/test_exchange.py."""
+    import shutil
+    import warnings as _warnings
+
+    from federated_pytorch_test_tpu.engine import Trainer
+
+    tr, cfg, tmp = health_run
+    tag = tr._stream_tag()
+    n_health = len(tr.recorder.series["health"])
+    # resume the finished run on a COPY of its stream (opening truncates
+    # the post-marker tail), with BOTH health knobs flipped
+    stream_copy = str(tmp_path / "m.jsonl")
+    shutil.copy(tmp / "m.jsonl", stream_copy)
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        tr2 = Trainer(
+            cfg.replace(
+                resume="auto", metrics_stream=stream_copy, health_window=32
+            ),
+            verbose=False,
+            source=_src,
+        )
+    refusals = [
+        w for w in caught
+        if "different experiment" in str(w.message)
+        or "no commit marker" in str(w.message)
+    ]
+    assert not refusals, [str(w.message) for w in refusals]
+    assert tr2._completed_nloops == cfg.nloop
+    # tag identity is the splice mechanism: health knobs are OUT. The
+    # digest reads only (cfg, injector), so a shallow copy with a
+    # swapped cfg probes it without paying another Trainer build
+    # (tier-1 wall budget — the suite sits near the 870 s gate)
+    assert tr2._stream_tag() == tag
+    import copy
+
+    probe = copy.copy(tr)
+    probe.cfg = cfg.replace(health_monitor=False)
+    assert probe._stream_tag() == tag
+    # a real experiment knob still refuses (the PR-3 contract intact)
+    probe.cfg = cfg.replace(nadmm=3)
+    assert probe._stream_tag() != tag
+    # the replayed stream seeded both the series and the engine
+    assert len(tr2.recorder.series["health"]) == n_health
+    assert tr2._health_engine.rounds == n_health
+    assert tr2._health_engine.loss.count == tr._health_engine.loss.count

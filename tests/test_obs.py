@@ -204,6 +204,82 @@ def test_trace_recorder_chrome_format_and_nesting(tmp_path):
     assert not list(tmp_path.glob("*.tmp"))
 
 
+# --------------------------------------------------- roofline telemetry
+
+
+@smoke
+def test_chip_peaks_lookup():
+    from federated_pytorch_test_tpu.obs import chip_peaks
+
+    assert chip_peaks("TPU v5 lite") == (197.0, 819.0)
+    assert chip_peaks("TPU v4 (something)") == (275.0, 1228.0)
+    assert chip_peaks("cpu") == (None, None)
+
+
+@smoke
+def test_lbfgs_round_cost_hand_checked_arithmetic():
+    """The analytic cost model's terms, hand-computed: n=1000, m=10,
+    4 inner iterations, default func evals (1 + max_iter = 5), one
+    client, one step, f32."""
+    from federated_pytorch_test_tpu.obs import lbfgs_round_cost
+
+    c = lbfgs_round_cost(
+        n_params=1000, history=10, max_iter=4, k_clients=1, steps=1,
+    )
+    # params: 5 evals x 2n values; history: 4 x (2*10*1000 + 2*1000)
+    assert c["hbm_bytes"] == (5 * 2000 + 4 * 22000) * 4
+    assert c["flops"] == 4 * 8.0 * 10 * 1000  # BLAS1 only
+    assert c["model_flops_included"] is False
+    assert c["func_evals_per_step"] == 5
+
+    # the probe-fan amortization: 4 extra probe evals share ONE widened
+    # parameter stream at ls_probes=4 (the --linesearch-probes lever)
+    seq = lbfgs_round_cost(
+        n_params=1000, history=10, max_iter=4, k_clients=1, steps=1,
+        func_evals_per_step=9, ls_probes=1,
+    )
+    fan = lbfgs_round_cost(
+        n_params=1000, history=10, max_iter=4, k_clients=1, steps=1,
+        func_evals_per_step=9, ls_probes=4,
+    )
+    assert seq["hbm_bytes"] - fan["hbm_bytes"] == (4 - 1) * 2000 * 4
+    # multipliers: steps x nepoch x nadmm x K
+    big = lbfgs_round_cost(
+        n_params=1000, history=10, max_iter=4, k_clients=3, steps=2,
+        nepoch=2, nadmm=5,
+    )
+    assert big["hbm_bytes"] == c["hbm_bytes"] * 3 * 2 * 2 * 5
+    assert big["steps_per_round"] == 60
+
+
+@smoke
+def test_roofline_record_hand_checked():
+    from federated_pytorch_test_tpu.obs import roofline_record
+
+    r = roofline_record(
+        wall_s=2.0, flops=197e12, hbm_bytes=819e9,
+        device_kind="TPU v5 lite",
+    )
+    # half of each peak in 2 s: 50% MFU, 50% HBM, intensity at the ridge
+    assert r["achieved_tflops"] == pytest.approx(98.5)
+    assert r["mfu"] == pytest.approx(0.5)
+    assert r["achieved_hbm_gbps"] == pytest.approx(409.5)
+    assert r["achieved_hbm_frac"] == pytest.approx(0.5)
+    assert r["arithmetic_intensity"] == pytest.approx(240.5, abs=0.1)
+    assert r["ridge_intensity"] == pytest.approx(240.5, abs=0.1)
+    assert r["bound"] == "compute"
+    # memory-bound verdict below the ridge
+    low = roofline_record(
+        wall_s=1.0, flops=1e12, hbm_bytes=819e9, device_kind="TPU v5 lite",
+    )
+    assert low["bound"] == "memory"
+    # unknown chip: achieved rates only, no fractions or verdict
+    cpu = roofline_record(wall_s=1.0, flops=1e9, hbm_bytes=1e9,
+                          device_kind="cpu")
+    assert "mfu" not in cpu and "bound" not in cpu
+    assert cpu["arithmetic_intensity"] == 1.0
+
+
 # ----------------------------------- Trainer integration (middle tier)
 # Unmarked (neither smoke nor slow): tier-1 tests over the same tiny
 # model/config family as tests/test_fault.py so the persistent compile
@@ -240,6 +316,9 @@ def fused_run(_src, tmp_path_factory):
         diagnostics_every=1,
     )
     tr = Trainer(cfg, verbose=False, source=_src)
+    # AOT-seed the round program: stashes its XLA cost counts so the run
+    # ends with a `roofline` record (asserted below; shares this run)
+    tr.compile_round(tr.group_order[0])
     tr.run()
     return tr, cfg, tmp
 
@@ -405,6 +484,30 @@ def test_diagnostics_every_matches_numpy_recomputation(fused_run):
             mask[s.start : s.start + s.size] = True
         expected.append(np.linalg.norm(diff[:, mask], axis=1).mean())
     np.testing.assert_allclose(vals, expected, rtol=1e-4, atol=1e-6)
+
+
+def test_compile_round_stashes_cost_and_records_roofline(fused_run):
+    """AOT-compiling the round program (the fused_run fixture seeds it)
+    stashes its exact XLA FLOP/byte counts; the run then ends with a
+    measured `roofline` record over the median fused-round wall —
+    process-local (stream=False), like recompile_count."""
+    tr, _, tmp = fused_run
+    gid = tr.group_order[0]
+    assert gid in tr._round_cost
+    c = tr._round_cost[gid]
+    assert c["flops"] > 0 and c["hbm_bytes"] > 0
+    recs = tr.recorder.series["roofline"]
+    assert len(recs) == 1 and recs[0]["group"] == gid
+    v = recs[0]["value"]
+    assert v["source"] == "xla_cost_analysis"
+    assert v["wall_s"] > 0
+    # XLA's counts over the measured wall: intensity = flops/bytes
+    assert v["arithmetic_intensity"] == pytest.approx(
+        c["flops"] / c["hbm_bytes"], abs=0.1
+    )
+    # never streamed: walls are process facts (a resumed run's differ)
+    lines = [json.loads(l) for l in open(tmp / "m.jsonl")]
+    assert "roofline" not in {l.get("series") for l in lines}
 
 
 def test_metrics_stream_crash_resume_identical(_src, tmp_path):

@@ -1,0 +1,251 @@
+"""Cross-run registry + `report` CLI tests (obs/registry.py).
+
+Smoke tier: stream ingestion/validation mechanics on hand-built JSONL
+files (header refusal mirrors the resume path's checks; torn tails
+tolerated), and the frontier hand-checked against two tiny synthetic
+runs with KNOWN ledger totals (the ISSUE-10 coverage item).
+
+Middle (default) tier: `report` over two real trainer runs (f32 vs bf16
+exchange — a two-point codec sweep) emits the convergence-vs-bytes
+frontier with the bf16 uplink exactly half the f32 one, and the output
+is byte-deterministic (the property the tier-2 report_smoke's
+crashed-twin byte-compare rides on).
+"""
+
+import json
+import warnings
+
+import pytest
+
+from federated_pytorch_test_tpu.obs import (
+    RunRegistry,
+    StreamRefused,
+    read_stream,
+    render_markdown,
+    report_main,
+)
+
+smoke = pytest.mark.smoke
+
+
+def _write_stream(path, tag, records, markers=(0,), torn_tail=False):
+    """A hand-built metric stream: header + records + commit markers."""
+    lines = [{"event": "stream_header", "version": 1, "tag": tag}]
+    lines += records
+    for m in markers:
+        lines.append({"event": "nloop_complete", "nloop": m})
+    with open(path, "w") as f:
+        for d in lines:
+            f.write(json.dumps(d) + "\n")
+        if torn_tail:
+            f.write('{"series": "train_loss", "val')  # crash mid-write
+    return path
+
+
+def _known_run(bytes_per_exchange, accs):
+    """Records of a run with KNOWN ledger totals: one comm_bytes +
+    test_accuracy pair per exchange."""
+    recs = []
+    for i, acc in enumerate(accs):
+        recs.append(
+            {"series": "comm_bytes", "t": 0.1 * i,
+             "value": bytes_per_exchange, "nloop": 0, "group": 2,
+             "nadmm": i, "survivors": 3}
+        )
+        recs.append(
+            {"series": "test_accuracy", "t": 0.1 * i, "value": acc,
+             "nloop": 0, "group": 2, "nadmm": i}
+        )
+    recs.append(
+        {"series": "comm_summary", "t": 1.0,
+         "value": {"exchange_dtype": "float32", "wire_bytes_per_value": 4,
+                   "bytes_per_round_mean": float(bytes_per_exchange),
+                   "savings_vs_full": 5.0}}
+    )
+    return recs
+
+
+# ------------------------------------------------------------- validation
+
+
+@smoke
+def test_read_stream_refuses_foreign_files(tmp_path):
+    # no header: not a metric stream
+    p = tmp_path / "not_a_stream.jsonl"
+    p.write_text('{"series": "train_loss", "value": [1.0]}\n')
+    with pytest.raises(StreamRefused, match="not a stream_header"):
+        read_stream(str(p))
+    # wrong version: a foreign format must not be misread
+    q = tmp_path / "future.jsonl"
+    q.write_text('{"event": "stream_header", "version": 99, "tag": "x"}\n')
+    with pytest.raises(StreamRefused, match="version"):
+        read_stream(str(q))
+    # empty file
+    r = tmp_path / "empty.jsonl"
+    r.write_text("")
+    with pytest.raises(StreamRefused, match="empty"):
+        read_stream(str(r))
+
+
+@smoke
+def test_read_stream_tolerates_torn_tail_and_stops_at_garbage(tmp_path):
+    p = _write_stream(
+        tmp_path / "a.jsonl", "exp:seed0:cfgx:noplan",
+        _known_run(120, [[0.5, 0.7]]), torn_tail=True,
+    )
+    run = read_stream(str(p))
+    assert run.tag == "exp:seed0:cfgx:noplan"
+    assert run.label == "exp:seed0"
+    assert run.markers == [0]
+    assert len(run.records) == 3  # torn tail dropped
+    # garbage mid-file: nothing past it is trusted (the resume rule)
+    with open(p, "w") as f:
+        f.write('{"event": "stream_header", "version": 1, "tag": "t"}\n')
+        f.write("}{ not json\n")
+        f.write('{"series": "comm_bytes", "value": 5}\n')
+    assert read_stream(str(p)).records == []
+
+
+@smoke
+def test_registry_match_filter_and_duplicate_names(tmp_path):
+    _write_stream(tmp_path / "a.jsonl", "fedavg:seed0:cfgx:noplan", [])
+    _write_stream(tmp_path / "b.jsonl", "admm:seed0:cfgy:noplan", [])
+    reg = RunRegistry(match="fedavg:seed0")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        skipped = reg.ingest_dir(str(tmp_path))
+    assert [s.endswith("b.jsonl") for s in skipped] == [True]
+    assert any("foreign experiment" in str(w.message) for w in caught)
+    assert set(reg.runs) == {"a"}
+    # the same run name twice is refused, not silently replaced
+    with pytest.raises(StreamRefused, match="already ingested"):
+        reg.ingest(str(tmp_path / "a.jsonl"))
+
+
+# ----------------------------------------------- frontier hand-check
+
+
+@smoke
+def test_report_frontier_hand_checked_against_known_totals(tmp_path):
+    """Two tiny runs with known ledger totals (the ISSUE-10 test item):
+    run `cheap` ships 3 x 100 B reaching 0.8, run `costly` 3 x 200 B
+    reaching 0.7 — cheap strictly dominates, costly is off the
+    frontier."""
+    _write_stream(
+        tmp_path / "cheap.jsonl", "fedavg:seed0:cfga:noplan",
+        _known_run(100, [[0.4, 0.6], [0.6, 0.8], [0.8, 0.8]]),
+    )
+    _write_stream(
+        tmp_path / "costly.jsonl", "fedavg:seed0:cfgb:noplan",
+        _known_run(200, [[0.3, 0.5], [0.5, 0.7], [0.7, 0.7]]),
+    )
+    reg = RunRegistry()
+    assert reg.ingest_dir(str(tmp_path)) == []
+    doc = reg.report()
+
+    cheap, costly = doc["runs"]["cheap"], doc["runs"]["costly"]
+    assert cheap["total_comm_bytes"] == 300  # 3 exchanges x 100 B
+    assert costly["total_comm_bytes"] == 600
+    assert cheap["exchanges"] == costly["exchanges"] == 3
+    assert cheap["final_accuracy"] == pytest.approx(0.8)
+    assert costly["final_accuracy"] == pytest.approx(0.7)
+    # the curve is cumulative bytes at each eval, in stream order
+    assert [p["cum_bytes"] for p in cheap["curve"]] == [100, 200, 300]
+    assert [p["accuracy"] for p in cheap["curve"]] == [0.5, 0.7, 0.8]
+    assert cheap["comm"]["savings_vs_full"] == 5.0
+
+    front = {p["run"]: p for p in doc["frontier"]}
+    assert front["cheap"]["pareto"] is True
+    assert front["costly"]["pareto"] is False
+    # frontier rows sorted by total bytes
+    assert [p["run"] for p in doc["frontier"]] == ["cheap", "costly"]
+    # aligned-by-eval series for cross-run plots
+    assert doc["aligned"]["accuracy_by_eval"]["costly"] == [0.4, 0.6, 0.7]
+
+    md = render_markdown(doc)
+    assert "| cheap | fedavg:seed0 | 3 | 0.8000 | 300 | 3 | 0 |" in md
+    assert "| costly | 600 | 0.7000 |  |" in md
+
+
+@smoke
+def test_report_cli_writes_deterministic_outputs(tmp_path, capsys):
+    d = tmp_path / "runs"
+    d.mkdir()
+    _write_stream(
+        d / "a.jsonl", "fedavg:seed0:cfga:noplan",
+        _known_run(100, [[0.5, 0.5]]),
+    )
+    (d / "junk.jsonl").write_text("definitely not json\n")
+    out1, out2 = tmp_path / "r1.json", tmp_path / "r2.json"
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        assert report_main([str(d), "--json", str(out1), "--quiet"]) == 0
+        assert report_main([str(d), "--json", str(out2)]) == 0
+    # byte-determinism: the property the tier-2 report_smoke twin
+    # byte-compare rides on
+    assert out1.read_bytes() == out2.read_bytes()
+    assert "Convergence vs bytes frontier" in capsys.readouterr().out
+    # an all-refused directory exits nonzero
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert report_main([str(empty), "--quiet"]) == 1
+
+
+# ------------------------------------- Trainer integration (slow tier)
+# The real-sweep leg costs two full trainer runs; the tier-1 wall sits
+# within ~10 s of the 870 s gate, so it rides tier 2 (the frontier
+# arithmetic itself is gated in tier 0 above, and the end-to-end CLI
+# twin byte-compare in scripts/ci.sh report_smoke).
+
+
+@pytest.mark.slow
+def test_report_over_real_codec_combiner_sweep(tmp_path):
+    """The ISSUE-10 acceptance sweep: a real {codec × combiner} grid —
+    identical tiny configs crossed over exchange wire format
+    {f32, bf16} and robust combiner {mean, trimmed} — reported as one
+    directory. Per codec the ledger totals must show bf16 at EXACTLY
+    half the f32 bytes regardless of combiner (the PR-9 wire contract
+    through the registry path), every run health-monitored, and the
+    frontier emitted over all four points."""
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    src = synthetic_cifar(n_train=240, n_test=60)
+    d = tmp_path / "runs"
+    d.mkdir()
+    grid = [
+        (codec, agg)
+        for codec in ("float32", "bfloat16")
+        for agg in ("mean", "trimmed")
+    ]
+    for codec, agg in grid:
+        name = f"{'f32' if codec == 'float32' else 'bf16'}_{agg}"
+        cfg = get_preset(
+            "fedavg", batch=40, nloop=1, nadmm=2, max_groups=1,
+            model="net", check_results=True, eval_batch=30,
+            synthetic_ok=True, exchange_dtype=codec, robust_agg=agg,
+            robust_f=1, metrics_stream=str(d / f"{name}.jsonl"),
+        )
+        Trainer(cfg, verbose=False, source=src).run()
+
+    reg = RunRegistry()
+    assert reg.ingest_dir(str(d)) == []
+    doc = reg.report()
+    runs = doc["runs"]
+    assert set(runs) == {"f32_mean", "f32_trimmed", "bf16_mean",
+                         "bf16_trimmed"}
+    for agg in ("mean", "trimmed"):
+        f32, bf16 = runs[f"f32_{agg}"], runs[f"bf16_{agg}"]
+        assert f32["total_comm_bytes"] == 2 * bf16["total_comm_bytes"] > 0
+        assert bf16["comm"]["exchange_dtype"] == "bfloat16"
+        assert bf16["comm"]["wire_bytes_per_value"] == 2
+        assert f32["evals"] == bf16["evals"] == 2
+        assert f32["health"]["records"] == bf16["health"]["records"] == 1
+    # the frontier covers the whole grid; the best-accuracy bf16 run is
+    # on it by construction (no f32 run can dominate it on bytes, and
+    # ties among the equal-byte bf16 runs leave the better one standing)
+    assert len(doc["frontier"]) == 4
+    assert doc["frontier"][0]["run"].startswith("bf16")  # fewest bytes first
+    assert any(
+        p["pareto"] for p in doc["frontier"] if p["run"].startswith("bf16")
+    )
