@@ -474,6 +474,78 @@ def _hetero_probe():
     }
 
 
+def _fleet_probe():
+    """Auto-deadline vs a fixed-deadline sweep on a straggler fleet.
+
+    The closed-loop claim (ROADMAP item 3): `--round-deadline auto`
+    tracks the online client_time sketch, so it should match the BEST
+    fixed deadline an operator could have picked — without the sweep —
+    and beat the rest. The probe runs the REAL trainer over one 3x
+    straggler fleet at three fixed deadlines (nominal, mid, slowest-
+    client full-work: the operator's plausible picks) plus `auto`, and
+    reads each point's mean simulated round wall (`client_time.round`)
+    and final accuracy off the recorded series. The headline
+    `auto_deadline_speedup` is the worst EQUAL-ACCURACY fixed point's
+    wall over auto's (fixed points within 2 accuracy points of auto's;
+    all of them when none is) — what the adaptive policy saves against
+    a defensible-but-wrong constant. The full acceptance gate (churn +
+    liars, Pareto dominance on the report frontier) is the slow-tier
+    fleet test (tests/test_fleet.py) and the tier-2 fleet_smoke.
+    """
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    src = synthetic_cifar(n_train=3 * 40 * 2, n_test=60)
+    total_steps = 2  # 80-sample shards at batch 40
+    slow_factor = 3.0
+    base = dict(
+        n_clients=3, batch=40, nloop=5, nadmm=2, max_groups=1, model="net",
+        check_results=True, eval_batch=60, synthetic_ok=True,
+        # Bernoulli stragglers: MOST exchanges run at nominal speed, so
+        # the sketch's median p95 settles near the nominal full-work
+        # time and the post-warmup auto deadline keeps cutting the
+        # occasional straggler (an every-exchange straggler would drag
+        # the p95 signal up to the straggler's own time)
+        fault_plan=f"seed=5,slow=0.15:{slow_factor:g}",
+    )
+    points = {}
+    sweeps = {
+        "fixed_nominal": float(total_steps),
+        "fixed_mid": float(total_steps) * 2.0,
+        "fixed_slowest": float(total_steps) * slow_factor,
+        "auto": "auto",
+    }
+    for label, deadline in sweeps.items():
+        cfg = get_preset("fedavg", **base, round_deadline=deadline)
+        tr = Trainer(cfg, verbose=False, source=src)
+        tr.run()
+        rounds = [
+            r["value"]["round"] for r in tr.recorder.series["client_time"]
+        ]
+        acc = tr.recorder.latest("test_accuracy")
+        points[label] = {
+            "deadline": deadline,
+            "round_sim_wall_s": round(float(np.mean(rounds)), 4),
+            "final_accuracy": round(float(np.mean(acc)), 4),
+        }
+        tr.close()
+    auto = points["auto"]
+    fixed = {k: v for k, v in points.items() if k != "auto"}
+    equal = [
+        v for v in fixed.values()
+        if v["final_accuracy"] >= auto["final_accuracy"] - 0.02
+    ] or list(fixed.values())
+    worst = max(v["round_sim_wall_s"] for v in equal)
+    return {
+        "points": points,
+        "auto_deadline_speedup": round(
+            worst / auto["round_sim_wall_s"], 2
+        ),
+    }
+
+
 def _cohort_probe():
     """Cohort-mode wall vs virtual-population size N at fixed cohort C.
 
@@ -686,6 +758,12 @@ def main() -> None:
     except Exception as e:  # a failed probe must not kill the bench
         out["hetero"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    # ---- the fleet probe: auto deadline vs the fixed-deadline sweep ----
+    try:
+        out["fleet"] = _fleet_probe()
+    except Exception as e:  # a failed probe must not kill the bench
+        out["fleet"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # ---- the cohort probe: round wall flat in virtual-population N ----
     try:
         out["cohort"] = _cohort_probe()
@@ -876,6 +954,12 @@ def main() -> None:
     # 3x straggler (partial updates ride the participation machinery)
     headline["deadline_speedup"] = out.get("hetero", {}).get(
         "deadline_speedup"
+    )
+    # the closed-loop fact (auto-deadline PR): simulated round wall the
+    # adaptive policy saves against the worst equal-accuracy fixed
+    # deadline of the sweep (>= 1.0 means auto matched the best pick)
+    headline["auto_deadline_speedup"] = out.get("fleet", {}).get(
+        "auto_deadline_speedup"
     )
     # the cross-device scale fact (virtual-client cohort PR): warm
     # gather→round→scatter wall ratio at N=64 vs N=1024 with C fixed —

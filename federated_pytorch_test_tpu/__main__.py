@@ -47,7 +47,14 @@ deadline-based — each client runs the ragged inner-step budget it can
 afford (inside the same one-dispatch round program), deadline misses
 contribute partial updates instead of stalling the cohort, and
 tail-latency percentiles land in the `client_time` series
-(docs/FAULT.md §Heterogeneity).
+(docs/FAULT.md §Heterogeneity). The CLOSED LOOP: `--round-deadline
+auto[:pXX]` tracks the online client_time percentile sketch instead of
+a constant (decisions streamed as the `deadline` series, replayed from
+the stream on resume), a plan's `churn=<p>[:mean_absence]` axis churns
+virtual clients out of the sampler's available pool per outer loop,
+and `--cohort-weighting telemetry` steers sampling by each virtual
+client's observed speed / deadline-miss / dropout / quarantine history
+accumulated in the client store.
 
 Cross-device scale (clients/, docs/SCALE.md): `--virtual-clients N
 --cohort C` models a population of N virtual clients in a host-side
@@ -155,7 +162,7 @@ def _print_summary(recorder, cfg) -> None:
         # resume only via a replayed --metrics-stream
         order = (
             "drops", "stragglers", "crashes", "corruptions",
-            "deadline_misses", "capped_stalls", "quarantines",
+            "deadline_misses", "capped_stalls", "churned", "quarantines",
         )
         print(
             "# faults injected: "

@@ -11,9 +11,15 @@ semantics).
 
 A `CohortSampler` is the *schedule* of that participation and nothing
 else, designed with the same purity contract as `fault.FaultPlan`: the
-cohort of outer loop `nloop` is a pure function of `(seed, nloop)` alone
-— no execution history, no RNG object threaded across calls — so a
-crashed-and-resumed run re-derives every historical cohort exactly, the
+cohort of outer loop `nloop` is a pure function of `(seed, nloop)` and
+— for the closed-loop pieces — the RECORDED history alone: the churn
+axis's availability pool is pure in the fault plan's seed
+(fault/plan.py `availability`), and the 'telemetry' weighting reads
+per-virtual-client reliability state whose every update is committed
+with the loop that produced it (engine/trainer.py, docs/SCALE.md). No
+RNG object is threaded across calls, every draw lands in a per-loop
+history (checkpointed by the trainer), and so a crashed-and-resumed run
+re-derives — or replays — every historical cohort exactly: the
 trainer's resume path can reconstruct skipped loops' communication
 totals, and fused/unfused/restarted runs all train the identical cohort
 sequence. The sampler claims the "cohort" slot of the shared seed-fold
@@ -36,11 +42,12 @@ import numpy as np
 
 from federated_pytorch_test_tpu.fault.plan import fold_seed
 
-WEIGHTINGS = ("uniform", "samples", "identity")
+WEIGHTINGS = ("uniform", "samples", "identity", "telemetry")
 
 
 class CohortSampler:
-    """Draw the cohort of each outer loop, purely in `(seed, nloop)`.
+    """Draw the cohort of each outer loop, purely in `(seed, nloop,
+    recorded history)`.
 
     * `uniform`  — C of N without replacement, equal probability;
     * `samples`  — C of N without replacement, probability proportional
@@ -51,7 +58,24 @@ class CohortSampler:
       (requires C == N): every loop trains `arange(N)`. This is the
       bitwise bridge to the pre-cohort engine — N=K, C=K, identity
       reproduces the legacy every-client-every-round trajectory exactly
-      (tests/test_clients.py).
+      (tests/test_clients.py);
+    * `telemetry` — probability from OBSERVED per-virtual-client
+      reliability (`telemetry_weights`: a provider returning `[N]`
+      positive weights from the client store's accumulated speed /
+      deadline-miss / dropout / quarantine history — engine/trainer.py
+      `_telemetry_weights`). History-dependent by design: the draw of
+      loop `nloop` is pure given the committed history through loop
+      `nloop - 1`, and the trainer checkpoints the draw history so a
+      resumed run REPLAYS past cohorts (`seed_history`) instead of
+      re-drawing them from restored state.
+
+    `availability` (optional) is the churn hook (fault/plan.py): a
+    callable `nloop -> [N] mask or None` restricting each loop's draw
+    to the available pool. When fewer than C clients are available, the
+    whole pool trains and the REMAINDER is recalled from the absent
+    pool by the same loop rng — the compiled client axis is static, so
+    a short cohort is not an option, and a deterministic recall keeps
+    the schedule pure.
     """
 
     def __init__(
@@ -61,6 +85,8 @@ class CohortSampler:
         seed: int = 0,
         weighting: str = "uniform",
         sample_counts: Optional[np.ndarray] = None,
+        telemetry_weights=None,
+        availability=None,
     ):
         if n_virtual < 1:
             raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
@@ -77,10 +103,28 @@ class CohortSampler:
                 "identity weighting is full participation: cohort "
                 f"({cohort}) must equal n_virtual ({n_virtual})"
             )
+        if weighting == "telemetry" and telemetry_weights is None:
+            raise ValueError(
+                "weighting='telemetry' needs a telemetry_weights "
+                "provider (per-virtual-client reliability state)"
+            )
+        if weighting == "identity" and availability is not None:
+            # tolerated only as a no-op hook: the trainer passes its
+            # lazy availability closure unconditionally, and identity
+            # runs never schedule churn (engine/trainer.py rejects the
+            # combination) — a RESTRICTED identity draw would be a
+            # contradiction, caught at draw time below
+            pass
         self.n_virtual = int(n_virtual)
         self.cohort_size = int(cohort)
         self.seed = int(seed)
         self.weighting = weighting
+        self._telemetry_weights = telemetry_weights
+        self._availability = availability
+        # nloop -> [C] draw history: a transparent cache for the pure
+        # weightings (re-derivation matches), the REPLAY substrate for
+        # the history-dependent one (trainer checkpoints + re-seeds it)
+        self._history: dict = {}
         self._p = None
         if weighting == "samples":
             if sample_counts is None:
@@ -110,36 +154,136 @@ class CohortSampler:
     def cohort(self, nloop: int) -> np.ndarray:
         """`[C]` int64 virtual-client ids of outer loop `nloop`, ascending.
 
-        Pure in `(seed, nloop)`: two calls — in different processes,
+        For the pure weightings, two calls — in different processes,
         before and after a crash, with any interleaving — return the
-        identical array. The last loop's draw is memoized (purity makes
-        the cache transparent): the trainer re-derives the cohort at
-        every fault-schedule projection of the loop. Callers must treat
-        the returned array as read-only.
+        identical array; the per-loop history is a transparent cache
+        (the trainer re-derives the cohort at every fault-schedule
+        projection of the loop). For 'telemetry' the first call of a
+        loop IS the draw (from the reliability state as of that
+        moment); later calls replay it from history — which resume
+        re-seeds from the checkpoint (`seed_history`), never re-draws.
+        Callers must treat the returned array as read-only.
         """
-        cached = getattr(self, "_memo", None)
-        if cached is not None and cached[0] == nloop:
-            return cached[1]
+        cached = self._history.get(int(nloop))
+        if cached is not None:
+            return cached
         ids = self._draw(nloop)
-        self._memo = (nloop, ids)
+        self._history[int(nloop)] = ids
         return ids
 
+    def seed_history(self, nloop: int, ids) -> None:
+        """Install a checkpointed draw for loop `nloop` (resume path):
+        history-dependent weightings must REPLAY completed loops'
+        cohorts, not re-draw them from restored state."""
+        ids = np.sort(np.asarray(ids, np.int64).reshape(-1))
+        if ids.shape[0] != self.cohort_size:
+            raise ValueError(
+                f"seeded cohort for loop {nloop} has {ids.shape[0]} "
+                f"members, expected {self.cohort_size}"
+            )
+        self._history[int(nloop)] = ids
+
+    def _weights(self) -> Optional[np.ndarray]:
+        """The draw's `[N]` probability vector (summing to 1), or None
+        for uniform draws."""
+        if self.weighting == "samples":
+            return self._p
+        if self.weighting == "telemetry":
+            w = np.asarray(
+                self._telemetry_weights(), np.float64
+            ).reshape(-1)
+            if w.shape[0] != self.n_virtual or not (
+                np.isfinite(w).all() and (w > 0).all()
+            ):
+                raise ValueError(
+                    "telemetry_weights must return [n_virtual] finite "
+                    "positive weights (a zero weight would starve a "
+                    "client forever on early evidence)"
+                )
+            return w / w.sum()
+        return None
+
+    def draw_weights(self, nloop: int):
+        """The normalized `[N]` probability vector loop `nloop`'s draw
+        used (None for uniform draws) — memoized by the draw itself, so
+        the trainer's `cohort_weight` record costs no second
+        full-population telemetry gather. Only valid for the most
+        recent draw (history-replayed loops never re-derive weights)."""
+        cached = getattr(self, "_last_weights", None)
+        if cached is not None and cached[0] == int(nloop):
+            return cached[1]
+        return self._weights()
+
     def _draw(self, nloop: int) -> np.ndarray:
+        avail = (
+            self._availability(nloop)
+            if self._availability is not None
+            else None
+        )
+        if avail is not None:
+            avail = np.asarray(avail).reshape(-1) > 0
+            if avail.shape[0] != self.n_virtual:
+                raise ValueError(
+                    f"availability mask has {avail.shape[0]} entries "
+                    f"for n_virtual={self.n_virtual}"
+                )
+            if avail.all():
+                avail = None  # unrestricted pool: the common case
         if self.weighting == "identity":
+            if avail is not None:
+                raise ValueError(
+                    "identity weighting (full participation) cannot "
+                    "draw from a churned pool"
+                )
             return np.arange(self.n_virtual, dtype=np.int64)
         rng = self._rng(nloop)
-        ids = rng.choice(
-            self.n_virtual,
-            size=self.cohort_size,
-            replace=False,
-            p=self._p,
-            # the default (True) would permute all N ids per draw; at
-            # N ≫ C that is the sampler's whole cost. Floyd's algorithm
-            # draws C of N in O(C). Selection DISTRIBUTION per id is
-            # unchanged for uniform draws; the draw order differs, which
-            # the ascending slot order erases anyway.
-            shuffle=False,
-        )
+        p = self._weights()
+        self._last_weights = (int(nloop), p)
+
+        def choice(pool: np.ndarray, size: int) -> np.ndarray:
+            pp = None
+            if p is not None:
+                if pool.shape[0] == self.n_virtual:
+                    pp = p  # full pool: skip the renormalization (its
+                    # float division would perturb the legacy draws)
+                else:
+                    pp = p[pool]
+                    pp = pp / pp.sum()
+            return pool[
+                rng.choice(
+                    pool.shape[0],
+                    size=size,
+                    replace=False,
+                    p=pp,
+                    # the default (True) would permute the whole pool
+                    # per draw; at N ≫ C that is the sampler's whole
+                    # cost. Floyd's algorithm draws C of N in O(C).
+                    # Selection DISTRIBUTION per id is unchanged for
+                    # uniform draws; the draw order differs, which the
+                    # ascending slot order erases anyway.
+                    shuffle=False,
+                )
+            ]
+
+        if avail is None:
+            ids = choice(
+                np.arange(self.n_virtual, dtype=np.int64),
+                self.cohort_size,
+            )
+        else:
+            pool = np.nonzero(avail)[0]
+            if pool.shape[0] >= self.cohort_size:
+                ids = choice(pool, self.cohort_size)
+            else:
+                # RECALL rule (docstring): the whole available pool
+                # trains, and the remainder is drawn from the absent
+                # pool by the same loop rng — deterministic, and the
+                # compiled client axis keeps its static width
+                absent = np.nonzero(~avail)[0]
+                extra = choice(
+                    absent, self.cohort_size - pool.shape[0]
+                )
+                ids = np.concatenate([pool, extra])
         return np.sort(ids.astype(np.int64))
 
     def participation_counts(self, nloops: int) -> np.ndarray:

@@ -26,6 +26,7 @@ from federated_pytorch_test_tpu.consensus.penalties import elastic_net, soft_thr
 from federated_pytorch_test_tpu.consensus.robust import (
     ROBUST_METHODS,
     apply_corruption,
+    quarantine_release_2f,
     robust_combine,
     update_suspects,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "admm_penalty",
     "admm_round",
     "apply_corruption",
+    "quarantine_release_2f",
     "elastic_net",
     "fedavg_init",
     "fedavg_round",

@@ -61,6 +61,26 @@ from federated_pytorch_test_tpu.parallel.collectives import (
 ROBUST_METHODS = ("mean", "median", "trimmed", "clip")
 
 
+def quarantine_release_2f(method: str, trim_f: int) -> int | None:
+    """The quarantine-release threshold for one combiner, or None.
+
+    With `trimmed(f)`, an exchange whose quarantine-trusted cohort
+    shrinks to <= 2f cannot trim meaningfully — trimmed(1)-of-2 trims
+    every coordinate and keeps z (the documented PR-9 ~40-point K=3
+    collapse) — so such an exchange RELEASES the quarantine mask and
+    lets the trim itself defend (docs/FAULT.md §Quarantine). THE one
+    definition on purpose: it gates the compiled program's in-scan
+    release (engine/steps.py build_round_fn) AND the host replay of
+    both trainer paths + the comm ledger's wasted-uplink attribution
+    (engine/trainer.py) — a drifted copy would let the program's
+    combine disagree with the ledger. Release is trimmed-scoped:
+    median/clip/mean keep the original exclusion semantics.
+    """
+    if method == "trimmed" and trim_f > 0:
+        return 2 * trim_f
+    return None
+
+
 # ------------------------------------------------------- corruption model
 
 

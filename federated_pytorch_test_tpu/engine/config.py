@@ -12,6 +12,8 @@ entries of `PRESETS`. A real CLI lives in
 from __future__ import annotations
 
 import dataclasses
+import math
+import re
 from typing import Tuple
 
 from federated_pytorch_test_tpu.consensus import ADMMConfig, ROBUST_METHODS
@@ -91,6 +93,11 @@ class ExperimentConfig:
     cohort_seed: int = 0
     # 'uniform' | 'samples' (probability ∝ per-client sample count) |
     # 'identity' (full participation; requires cohort == virtual_clients)
+    # | 'telemetry' (probability from observed per-virtual-client
+    # reliability: mean speed, deadline misses, dropouts, quarantine
+    # history — accumulated in the ClientStore at scatter time and pure
+    # in (seed, nloop, recorded history), so crashed+resumed twins
+    # sample identical cohorts; clients/cohort.py, docs/SCALE.md)
     cohort_weighting: str = "uniform"
     # how many disjoint data shards the virtual population maps onto
     # (client v holds shard v % data_shards; the store records the
@@ -371,7 +378,17 @@ class ExperimentConfig:
     # sets the round's simulated wall clock). Requires a consensus
     # strategy; uniform budgets (a deadline no client misses) reproduce
     # the lockstep trajectory bitwise (tests/test_hetero.py).
-    round_deadline: float | None = None
+    # CLOSED LOOP: 'auto' (= 'auto:p50') or 'auto:pXX' makes each
+    # round's deadline track the online client_time percentile sketch
+    # (obs/health.py DeadlineController): the pXX of the observed
+    # per-exchange cross-client p95 simulated times, falling back to
+    # the nominal full-work time until the sketch has
+    # DEADLINE_WARMUP_OBS observations. Decisions are pure in the
+    # recorded history (streamed as the `deadline` series) and
+    # replay-identical across crash+resume — resuming an auto run
+    # REQUIRES a metrics stream to replay them from (docs/FAULT.md
+    # §Heterogeneity).
+    round_deadline: float | str | None = None
 
     # 'auto': restore the latest READABLE checkpoint under checkpoint_dir
     # if one exists, else start fresh — the crash-recovery switch a chaos
@@ -429,10 +446,13 @@ class ExperimentConfig:
                     f"cohort must be in [1, virtual_clients="
                     f"{self.virtual_clients}], got {self.cohort}"
                 )
-            if self.cohort_weighting not in ("uniform", "samples", "identity"):
+            if self.cohort_weighting not in (
+                "uniform", "samples", "identity", "telemetry"
+            ):
                 raise ValueError(
-                    "cohort_weighting must be 'uniform', 'samples' or "
-                    f"'identity', got {self.cohort_weighting!r}"
+                    "cohort_weighting must be 'uniform', 'samples', "
+                    f"'identity' or 'telemetry', got "
+                    f"{self.cohort_weighting!r}"
                 )
             if (
                 self.cohort_weighting == "identity"
@@ -570,10 +590,61 @@ class ExperimentConfig:
             raise ValueError(
                 f"quarantine_z must be >= 0, got {self.quarantine_z}"
             )
-        if self.round_deadline is not None and not self.round_deadline > 0:
-            raise ValueError(
-                f"round_deadline must be > 0, got {self.round_deadline}"
-            )
+        if self.round_deadline is not None:
+            rd = self.round_deadline
+            if isinstance(rd, str):
+                # the CLI hands every value through as a string; numeric
+                # ones normalize to the float they always were, 'auto'
+                # canonicalizes to 'auto:p50' so equal policies hash —
+                # and stream-tag — equally
+                s = rd.strip()
+                try:
+                    rd = float(s)
+                except ValueError:
+                    m = re.fullmatch(r"auto(?::p([1-9][0-9]?))?", s)
+                    if m is None:
+                        raise ValueError(
+                            "round_deadline must be a positive number of "
+                            "simulated seconds, 'auto', or 'auto:pXX' "
+                            f"(XX an integer percentile in [1, 99]), "
+                            f"got {self.round_deadline!r}"
+                        )
+                    rd = f"auto:p{m.group(1) or 50}"
+            if not isinstance(rd, str):
+                # anything that is not the auto policy must BE a
+                # positive finite number — coerced, so numpy scalars
+                # validate (and normalize) like the floats they quack as
+                # instead of bypassing the check on an isinstance test
+                if isinstance(rd, bool):
+                    raise ValueError(
+                        f"round_deadline must be > 0, got {rd!r}"
+                    )
+                try:
+                    rd = float(rd)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "round_deadline must be a positive number of "
+                        "simulated seconds, 'auto', or 'auto:pXX', "
+                        f"got {self.round_deadline!r}"
+                    )
+                if not (math.isfinite(rd) and rd > 0):
+                    raise ValueError(
+                        f"round_deadline must be > 0, got {rd}"
+                    )
+            object.__setattr__(self, "round_deadline", rd)
+
+    @property
+    def deadline_is_auto(self) -> bool:
+        """Whether `round_deadline` is the closed-loop 'auto:pXX' policy
+        (already canonicalized by `__post_init__`)."""
+        return isinstance(self.round_deadline, str)
+
+    @property
+    def deadline_quantile(self) -> float:
+        """The auto policy's sketch quantile in (0, 1) — e.g. 0.5 for
+        'auto:p50'. Only meaningful when `deadline_is_auto`."""
+        assert self.deadline_is_auto, self.round_deadline
+        return int(self.round_deadline.split(":p")[1]) / 100.0
 
     def lbfgs_config(self) -> LBFGSConfig:
         return LBFGSConfig(

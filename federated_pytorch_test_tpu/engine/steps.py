@@ -82,6 +82,7 @@ from federated_pytorch_test_tpu.consensus import (
     elastic_net,
     fedavg_init,
     fedavg_round,
+    quarantine_release_2f,
     update_suspects,
 )
 from federated_pytorch_test_tpu.data import normalize
@@ -97,6 +98,7 @@ from federated_pytorch_test_tpu.parallel import (
     mark_varying,
     path_component_name,
 )
+from federated_pytorch_test_tpu.parallel.collectives import client_sum
 from federated_pytorch_test_tpu.partition import Partition
 
 PyTree = Any
@@ -904,6 +906,18 @@ def build_round_fn(
     quarantine = (
         ctx.quarantine_z is not None and consensus_local is not None
     )
+    # quarantine RELEASE threshold (consensus/robust.py
+    # quarantine_release_2f — THE one definition, shared with the
+    # trainer's host replay): an exchange whose quarantine-trusted
+    # cohort would be <= 2f releases the mask (suspects transmit and
+    # are combined; the trim itself is the defense) while detection —
+    # the suspect flags, their records, the qmask carry — continues
+    # unchanged. Static: None compiles the exact pre-release program.
+    release_2f = (
+        quarantine_release_2f(ctx.robust_agg, ctx.robust_f)
+        if quarantine
+        else None
+    )
     ragged = ctx.ragged
 
     def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
@@ -982,7 +996,20 @@ def build_round_fn(
                         eff_mask.dtype
                     )
                 if quarantine:
-                    eff_mask = eff_mask * qmask
+                    gated = eff_mask * qmask
+                    if release_2f is not None:
+                        # release the quarantine where it would leave
+                        # the trimmed combiner <= 2f trusted clients
+                        # (see build-time comment); the host replays
+                        # this decision from the fetched suspect
+                        # matrices for the ledger's wasted-uplink
+                        # attribution (engine/trainer.py)
+                        trusted = client_sum(gated, local_axis=0)
+                        eff_mask = jnp.where(
+                            trusted > release_2f, gated, eff_mask
+                        )
+                    else:
+                        eff_mask = gated
                 flat, y, z, rho, extra, met, qstats = consensus_local(
                     flat, y, z, rho, extra, na, eff_mask, *corr_a
                 )

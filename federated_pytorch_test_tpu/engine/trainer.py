@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from federated_pytorch_test_tpu.clients import ClientStore, CohortSampler
+from federated_pytorch_test_tpu.consensus import quarantine_release_2f
 from federated_pytorch_test_tpu.data import (
     client_stats,
     load_cifar,
@@ -65,6 +66,7 @@ from federated_pytorch_test_tpu.fault import (
 from federated_pytorch_test_tpu.models import MODELS
 from federated_pytorch_test_tpu.obs import (
     CommLedger,
+    DeadlineController,
     DispatchCounter,
     HealthEngine,
     JsonlSink,
@@ -226,12 +228,30 @@ class Trainer:
                 name = "stats/" + jax.tree_util.keystr(path)
                 self._stats_fields.append(name)
                 self.store.register_field(name, np.asarray(leaf[0]))
+            # per-virtual-client reliability state (telemetry-steered
+            # cohorts, docs/SCALE.md): scalar counters accumulated in
+            # the store at scatter time — they ride the dirty-chunk
+            # checkpoint, so a restored run samples from exactly the
+            # history its checkpoint committed
+            if cfg.cohort_weighting == "telemetry":
+                for name in self._TELEM_FIELDS:
+                    self.store.register_field(
+                        name, np.zeros((), np.float32)
+                    )
             self.sampler = CohortSampler(
                 n_v,
                 cfg.cohort,
                 seed=cfg.cohort_seed,
                 weighting=cfg.cohort_weighting,
                 sample_counts=self.store.sample_counts,
+                telemetry_weights=(
+                    self._telemetry_weights
+                    if cfg.cohort_weighting == "telemetry"
+                    else None
+                ),
+                # lazy: the injector is built further down — and churn-
+                # free plans return None (an unrestricted pool)
+                availability=self._pool_availability,
             )
             # normalization stats are a property of the VIRTUAL client
             # (they follow it into whatever cohort slot it lands in);
@@ -384,6 +404,9 @@ class Trainer:
         self._health_fn = None
         self._completed_nloops = 0
         self._step_num = 0
+        self._loop_quar = None  # telemetry cohorts: the loop's [C]
+        # per-slot quarantine counts (reset each gather, folded into the
+        # store's reliability rows at scatter)
         self._round_poisoned = False  # set by the fault checks in
         # rollback mode; consumed at each partition-round boundary
         # per-(group, client) ADMM penalty, PERSISTENT across outer loops:
@@ -411,6 +434,21 @@ class Trainer:
                 # from; without checkpointing the record is process-local
                 state_dir=cfg.checkpoint_dir if cfg.save_model else None,
             )
+            if self.injector.has_churn:
+                if not self._cohort_mode:
+                    raise ValueError(
+                        "the fault plan schedules churn, which removes "
+                        "virtual clients from the sampler's available "
+                        "pool — it requires --virtual-clients/--cohort "
+                        "(a fixed cross-silo cohort has no pool to "
+                        "leave; model per-round absence with dropout)"
+                    )
+                if cfg.cohort_weighting == "identity":
+                    raise ValueError(
+                        "churn contradicts cohort_weighting='identity': "
+                        "identity is full participation every loop, but "
+                        "a churned client is unavailable to sample"
+                    )
         self._full_mask = _put(
             np.ones(cfg.n_clients, np.float32), csh
         )
@@ -474,6 +512,43 @@ class Trainer:
             if replay:
                 self._health_engine.replay(replay)
             self.recorder.observers.append(self._health_engine)
+        # closed-loop round deadlines (`--round-deadline auto[:pXX]`,
+        # obs/health.py DeadlineController): a pure observer of the
+        # streamed client_time records, replayed BEFORE attaching like
+        # the health engine. Each round's decision is memoized in
+        # `_deadline_decisions` (and streamed as the `deadline` series);
+        # replayed decisions seed the memo, so a resumed run's budget
+        # schedule — and its scoreboard — replay the crashed run's
+        # exactly instead of re-estimating from a cold sketch.
+        self._deadline_ctl = None
+        self._deadline_decisions: Dict[tuple, float] = {}
+        if self._ragged_enabled() and cfg.deadline_is_auto:
+            step_t = (
+                self.injector.plan.step_time_s
+                if self.injector is not None
+                else 1.0
+            )
+            self._deadline_ctl = DeadlineController(
+                cfg.deadline_quantile,
+                # warmup: the nominal full-work time — full budgets for
+                # nominal-speed clients until the sketch has evidence
+                warmup_s=float(self._round_total_steps() * step_t),
+            )
+            if self._completed_nloops and not replay:
+                raise ValueError(
+                    "resuming under --round-deadline auto requires the "
+                    "run's --metrics-stream: past deadline decisions are "
+                    "replayed from the stream, never re-estimated fresh "
+                    "(a cold sketch would silently shift every "
+                    "post-resume budget schedule)"
+                )
+            if replay:
+                self._deadline_ctl.replay(replay)
+                for rec in self.recorder.series.get("deadline", []):
+                    self._deadline_decisions[
+                        (int(rec["nloop"]), int(rec["group"]))
+                    ] = float(rec["value"]["seconds"])
+            self.recorder.observers.append(self._deadline_ctl)
         # AOT round-program cost analysis (obs/roofline.py), stashed by
         # compile_round per group: feeds the end-of-run `roofline` record.
         # Replayed step_time records are the CRASHED process's walls —
@@ -687,6 +762,32 @@ class Trainer:
             and self.cfg.strategy != "none"
         )
 
+    def _quarantine_release_2f(self) -> Optional[int]:
+        """The quarantine-release threshold, or None when release is off
+        — consensus/robust.py `quarantine_release_2f`, THE one
+        definition shared with the compiled program's in-scan release
+        (engine/steps.py build_round_fn), applied here to the host
+        replay of both trainer paths and the ledger's wasted-uplink
+        attribution."""
+        if not self._quarantine_enabled():
+            return None
+        return quarantine_release_2f(self.cfg.robust_agg, self.cfg.robust_f)
+
+    def _effective_exchange_mask(self, transmit_np, qmask_np, quarantine):
+        """One exchange's effective mask + wasted-sender count, the
+        quarantine-release rule applied — the host twin of the fused
+        program's in-scan decision (both paths call this; fused ==
+        unfused == ledger by construction). Returns
+        `(eff [K] f32, quarantined_now int)`: a released exchange
+        consumes its suspects' uplink (nothing wasted)."""
+        if not quarantine:
+            return transmit_np, 0
+        gated = transmit_np * qmask_np
+        release_2f = self._quarantine_release_2f()
+        if release_2f is not None and gated.sum() <= release_2f:
+            return transmit_np, 0
+        return gated, int((transmit_np * (1.0 - qmask_np)).sum())
+
     def _corruption_enabled(self) -> bool:
         """Whether the consensus programs carry the corruption inputs.
 
@@ -731,6 +832,50 @@ class Trainer:
         (the quantity a step budget is clipped against)."""
         return self.cfg.nepoch * self.fed.steps_per_epoch(self.cfg.batch)
 
+    def _deadline_for(self, nloop: int, gid: int) -> Optional[float]:
+        """Round `(nloop, gid)`'s deadline in simulated seconds.
+
+        Fixed mode returns the configured constant; auto mode returns
+        the memoized per-round decision (`_decide_deadline` takes it at
+        round start; resume seeds the memo from replayed `deadline`
+        records). Pure given the recorded history, so the budget rows,
+        the straggler caps, and the end-of-run scoreboard all consume
+        the ONE value per round. Never logs — the `deadline` record is
+        `_decide_deadline`'s, emitted exactly once at the round's start
+        (this accessor also serves resume-time reconstruction of
+        historical fixed-deadline rounds, which must not re-stream).
+        """
+        if self.cfg.round_deadline is None:
+            return None
+        if not self.cfg.deadline_is_auto:
+            return float(self.cfg.round_deadline)
+        key = (int(nloop), int(gid))
+        dl = self._deadline_decisions.get(key)
+        if dl is None:
+            # only run_round-adjacent paths reach here before the
+            # decision record: take it now, un-streamed (the caller is
+            # _decide_deadline itself or an out-of-band probe)
+            dl, _ = self._deadline_ctl.decide()
+            self._deadline_decisions[key] = dl
+        return dl
+
+    def _decide_deadline(self, nloop: int, gid: int) -> None:
+        """Take (and stream) round `(nloop, gid)`'s deadline decision —
+        called at the START of every deadline round, before any of the
+        round's own records land in the sketch, so fused and unfused
+        runs decide from the identical observation prefix."""
+        key = (int(nloop), int(gid))
+        if key in self._deadline_decisions:
+            return  # replayed from the stream, or already decided
+        if self.cfg.deadline_is_auto:
+            dl, info = self._deadline_ctl.decide()
+        else:
+            dl, info = float(self.cfg.round_deadline), {"source": "fixed"}
+        self._deadline_decisions[key] = dl
+        self.recorder.log(
+            "deadline", {"seconds": dl, **info}, nloop=nloop, group=gid
+        )
+
     def _round_hetero(self, nloop: int, gid: int):
         """One round's heterogeneity schedule, all host-side numpy.
 
@@ -756,13 +901,12 @@ class Trainer:
             step_t = 1.0
         times = total * step_t * speeds
         budgets = None
-        if cfg.round_deadline is not None:
+        dl = self._deadline_for(nloop, gid)
+        if dl is not None:
             # the ONE deadline->budget conversion (fault/injector.py
             # step_budgets) — shared with the scoreboard so the program's
             # budgets and the deadline_misses rows cannot drift apart
-            budgets = step_budgets(
-                speeds, step_t, total, cfg.round_deadline
-            )
+            budgets = step_budgets(speeds, step_t, total, dl)
         return speeds, budgets, times
 
     def _record_hetero(
@@ -773,7 +917,7 @@ class Trainer:
         at the deadline, since the coordinator closes the round there),
         the per-client step budgets, and a `deadline_miss` record when
         any client's budget fell short of the lockstep step count."""
-        deadline = self.cfg.round_deadline
+        deadline = self._deadline_for(nloop, gid)
         round_time = float(times_a.max())
         if deadline is not None:
             round_time = min(round_time, float(deadline))
@@ -824,6 +968,102 @@ class Trainer:
             if name.startswith("rho/")
         ]
 
+    # per-virtual-client reliability counters (telemetry-steered
+    # cohorts): scalar store fields, one row per client, accumulated at
+    # scatter time from the loop's PURE fault schedule (speeds, masks,
+    # budgets) plus the quarantine detections the round bookkeeping
+    # observed — the one execution-derived input, which the trajectory
+    # replay re-derives identically on resume.
+    _TELEM_FIELDS = (
+        "telem/exchanges",    # exchanges the client was scheduled into
+        "telem/speed_sum",    # Σ per-exchange speed multipliers
+        "telem/misses",       # deadline misses (budget < lockstep steps)
+        "telem/drops",        # plan dropouts while sampled
+        "telem/quarantines",  # times the defense flagged the client
+    )
+
+    def _telemetry_weights(self) -> np.ndarray:
+        """`[N]` positive sampling weights from the store's reliability
+        counters — the CohortSampler's 'telemetry' provider.
+
+        An unseen client gets the neutral prior (speed 1, no penalties,
+        weight 1); an observed client's weight is
+        `1 / (mean_speed * (1 + penalty_rate))` with `penalty_rate` the
+        per-exchange rate of misses + drops + quarantines — slow or
+        flaky phones are sampled less, reliable fast ones more, and no
+        weight ever reaches 0 (every client stays reachable — starving
+        a client forever on early evidence would be a fairness bug, not
+        a policy). Pure in the store state, which is pure in (seed,
+        nloop, recorded history) — so crashed+resumed twins, whose
+        stores restore to the same committed snapshot, re-derive
+        identical weights.
+        """
+        ids = np.arange(self.store.n_virtual, dtype=np.int64)
+        ex = self.store.gather("telem/exchanges", ids).astype(np.float64)
+        sp = self.store.gather("telem/speed_sum", ids).astype(np.float64)
+        miss = self.store.gather("telem/misses", ids).astype(np.float64)
+        drops = self.store.gather("telem/drops", ids).astype(np.float64)
+        quar = self.store.gather(
+            "telem/quarantines", ids
+        ).astype(np.float64)
+        n = np.maximum(ex, 1.0)
+        speed = np.where(ex > 0, sp / n, 1.0)
+        penalty = (miss + drops + quar) / n
+        return 1.0 / (speed * (1.0 + penalty))
+
+    def _pool_availability(self, nloop: int):
+        """The sampler's availability hook: the churn axis's `[N]` pool
+        mask for loop `nloop`, or None when the plan schedules no churn
+        (an unrestricted pool). Pure in (plan seed, nloop)."""
+        if self.injector is None or not self.injector.has_churn:
+            return None
+        return self.injector.availability(nloop)
+
+    def _update_telemetry(self, nloop: int, ids: np.ndarray) -> None:
+        """Fold one completed loop into the cohort's reliability rows
+        (called from `_end_loop_cohort`, before the store snapshot that
+        makes the loop durable — a crashed loop contributes nothing,
+        and its re-run contributes exactly once).
+
+        Speeds, drops, and budgets are re-derived from the pure plan
+        (and the loop's memoized deadline decisions); quarantines come
+        from the per-loop accumulator `_record_quarantine` maintains.
+        """
+        cfg = self.cfg
+        c = ids.size
+        exchanges = np.zeros(c, np.float32)
+        speed_sum = np.zeros(c, np.float32)
+        misses = np.zeros(c, np.float32)
+        drops = np.zeros(c, np.float32)
+        total = self._round_total_steps()
+        for gid in self.group_order:
+            if cfg.strategy == "none":
+                break  # no exchange: nothing to be reliable AT
+            speeds, budgets, _ = self._round_hetero(nloop, gid)
+            masks = (
+                self._vslice(
+                    self.injector.masks_for_round(nloop, gid, cfg.nadmm),
+                    nloop,
+                )
+                if self.injector is not None
+                else np.ones((cfg.nadmm, c), np.float32)
+            )
+            exchanges += cfg.nadmm
+            speed_sum += speeds.sum(axis=0).astype(np.float32)
+            drops += (masks <= 0).sum(axis=0).astype(np.float32)
+            if budgets is not None:
+                misses += (budgets < total).sum(axis=0).astype(np.float32)
+        updates = {
+            "telem/exchanges": exchanges,
+            "telem/speed_sum": speed_sum,
+            "telem/misses": misses,
+            "telem/drops": drops,
+            "telem/quarantines": self._loop_quar.astype(np.float32),
+        }
+        for name, delta in updates.items():
+            cur = self.store.gather(name, ids)
+            self.store.scatter(name, ids, cur + delta)
+
     def _begin_loop_cohort(self, nloop: int) -> None:
         """Gather loop `nloop`'s cohort out of the virtual-client store.
 
@@ -835,8 +1075,34 @@ class Trainer:
         normalization stats. `_owned_copy` for the donated carries, as
         everywhere host arrays feed donating programs (module header).
         """
+        if self.injector is not None and self.injector.has_churn:
+            # the loop's pool state (pure in the plan seed): how many
+            # virtual clients the churn axis removed from the sampler's
+            # reach — streamed, so twins replay it identically
+            avail = self.injector.availability(nloop)
+            self.recorder.log(
+                "availability",
+                {
+                    "available": int(avail.sum()),
+                    "absent": int(avail.size - avail.sum()),
+                },
+                nloop=nloop,
+            )
         ids = self.sampler.cohort(nloop)
         self._cohort_ids = ids
+        if self.cfg.cohort_weighting == "telemetry":
+            # the sampled cohort's normalized draw weights — the
+            # steering evidence, aligned to cohort slots; pure in the
+            # committed store history, so twins stream identical rows
+            # (the sampler memoized the vector its draw used — no
+            # second full-population telemetry gather)
+            wn = self.sampler.draw_weights(nloop)
+            self.recorder.log(
+                "cohort_weight",
+                {"weights": [round(float(wn[v]), 9) for v in ids]},
+                nloop=nloop,
+            )
+            self._loop_quar = np.zeros(ids.size, np.float64)
         csh = client_sharding(self.mesh)
         with self.recorder.phase("cohort_gather", record=False, nloop=nloop):
             self.flat = _owned_copy(
@@ -906,6 +1172,13 @@ class Trainer:
                         ),
                     )
                 self.store.scatter(name, ids, rho_np)
+            if self.cfg.cohort_weighting == "telemetry":
+                # reliability counters ride the same scatter-side commit
+                # discipline as the state rows: a loop that crashes
+                # before here contributes nothing, its re-run exactly
+                # once (docs/SCALE.md §Telemetry-steered cohorts)
+                self._update_telemetry(nloop, ids)
+                self._loop_quar = None
 
     def _fns(self, gid: int):
         if gid not in self._epoch_fns:
@@ -1155,6 +1428,10 @@ class Trainer:
             self.recorder.quarantine(
                 flagged, nloop=nloop, group=group, nadmm=nadmm
             )
+            if self._loop_quar is not None:
+                # telemetry cohorts: quarantine history follows the
+                # VIRTUAL client (slot -> id at scatter time)
+                self._loop_quar[flagged] += 1
         return qmask_np * (1.0 - s)
 
     def _local_clients(self) -> list:
@@ -1555,6 +1832,13 @@ class Trainer:
         """
         before = self._dispatch.snapshot()
         compiled_before = self._dispatch.compiled_programs()
+        if self._ragged_enabled():
+            # the round's deadline decision (and its `deadline` record)
+            # is taken HERE, before any of the round's own client_time
+            # observations can land in the auto policy's sketch — the
+            # same position in both trainer paths, so fused and unfused
+            # runs decide from the identical prefix
+            self._decide_deadline(nloop, gid)
         try:
             with self.recorder.phase("round", record=False, nloop=nloop, group=gid):
                 if self._fused_enabled():
@@ -1772,11 +2056,12 @@ class Trainer:
                     )
                     delay = self.injector.straggler_delay(nloop, gid, nadmm)
                     if delay > 0:
-                        if cfg.round_deadline is not None:
+                        dl_cap = self._deadline_for(nloop, gid)
+                        if dl_cap is not None:
                             # deadline rounds cap the coordinator's wait:
                             # past the deadline the round closes without
                             # the straggler instead of stalling for it
-                            delay = min(delay, cfg.round_deadline)
+                            delay = min(delay, dl_cap)
                         # the coordinator waiting out a slow client before
                         # declaring the round: a host-side stall, recorded
                         # so chaos runs show up in the timing series
@@ -1800,14 +2085,11 @@ class Trainer:
                     m_np * (budgets_a > 0) if ragged else m_np
                 ).astype(np.float32)
                 # quarantined clients still transmit (they don't know);
-                # the exchange just discards their contribution
-                quarantined_now = (
-                    int((transmit_np * (1.0 - qmask_np)).sum())
-                    if quarantine
-                    else 0
-                )
-                eff_np = (
-                    transmit_np * qmask_np if quarantine else transmit_np
+                # the exchange discards their contribution — unless the
+                # release rule fires (_effective_exchange_mask), in
+                # which case it consumes it
+                eff_np, quarantined_now = self._effective_exchange_mask(
+                    transmit_np, qmask_np, quarantine
                 )
                 mask = (
                     self._full_mask
@@ -1954,11 +2236,12 @@ class Trainer:
                 self.injector.straggler_delays_for_round(nloop, gid, cfg.nadmm)
             ):
                 if d > 0:
-                    if cfg.round_deadline is not None:
+                    dl_cap = self._deadline_for(nloop, gid)
+                    if dl_cap is not None:
                         # deadline rounds cap the coordinator's wait: past
                         # the deadline the round closes without the
                         # straggler instead of stalling for it
-                        d = min(d, cfg.round_deadline)
+                        d = min(d, dl_cap)
                     self.recorder.step_time(
                         "straggler_wait", d, nloop=nloop, group=gid, nadmm=a
                     )
@@ -2094,10 +2377,8 @@ class Trainer:
                 transmit = masks_np[a]
                 if ragged:
                     transmit = transmit * (budgets_np[a] > 0)
-                quarantined_now = (
-                    int((transmit * (1.0 - qmask_np)).sum())
-                    if quarantine
-                    else 0
+                _, quarantined_now = self._effective_exchange_mask(
+                    transmit, qmask_np, quarantine
                 )
                 self._comm.record(
                     self.recorder, gid, int(transmit.sum()),
@@ -2224,9 +2505,16 @@ class Trainer:
                     total_steps=self._round_total_steps(),
                     # deadline rows only where deadline rounds are active
                     # (_ragged_enabled — strategy 'none' has no exchange
-                    # to miss the deadline of)
+                    # to miss the deadline of); auto mode hands the
+                    # scoreboard its per-round decision history (every
+                    # round decided by now — live or stream-replayed),
+                    # so the totals stay resume-proof
                     deadline_s=(
-                        cfg.round_deadline
+                        (
+                            dict(self._deadline_decisions)
+                            if cfg.deadline_is_auto
+                            else float(cfg.round_deadline)
+                        )
                         if self._ragged_enabled()
                         else None
                     ),
@@ -2339,6 +2627,18 @@ class Trainer:
         }
         if self._qkv_layout is not None:
             state["qkv_layout"] = np.int64(self._qkv_layout)
+        if self._cohort_mode and self._completed_nloops:
+            # the completed loops' cohort draws, [completed, C] — tiny.
+            # Uniform/samples draws are re-derivable from (seed, nloop)
+            # alone, but telemetry-weighted draws depend on the evolving
+            # reliability state: a resumed run must REPLAY history, not
+            # re-draw it from whatever state it restored mid-stream.
+            state["cohort_history"] = np.stack(
+                [
+                    np.asarray(self.sampler.cohort(l), np.int64)
+                    for l in range(self._completed_nloops)
+                ]
+            )
         if self._stream:
             if jax.process_count() > 1:
                 raise NotImplementedError(
@@ -2410,6 +2710,18 @@ class Trainer:
         for g, r in state.get("rho_store", {}).items():
             self._rho_store[int(g)] = _owned_copy(self._put(r, csh))
         if self._cohort_mode:
+            hist = state.get("cohort_history")
+            if hist is not None:
+                # seed the sampler's draw history with the completed
+                # loops' cohorts: telemetry-weighted draws are history-
+                # dependent (the weights evolved with the store), so the
+                # resumed run REPLAYS them instead of re-drawing from
+                # restored state; for the pure weightings this is a
+                # transparent cache (re-derivation would match bitwise)
+                hist = np.asarray(hist)
+                for l in range(min(int(hist.shape[0]),
+                                   self._completed_nloops)):
+                    self.sampler.seed_history(l, hist[l])
             # the store snapshot committed WITH this checkpoint (its
             # manifest step is the restored loop cursor — Trainer.save
             # writes both under the same step). Lazily-registered rho

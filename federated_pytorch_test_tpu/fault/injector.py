@@ -149,6 +149,29 @@ class FaultInjector:
         record no client_time series — engine/trainer.py)."""
         return self.plan.has_heterogeneity
 
+    @property
+    def has_churn(self) -> bool:
+        """Whether the plan churns the available pool at all (virtual
+        populations only — the Trainer rejects churn plans without
+        `--virtual-clients`, since a fixed cross-silo cohort has no
+        pool to leave)."""
+        return self.plan.has_churn
+
+    def availability(self, nloop: int) -> np.ndarray:
+        """`[N]` float32 pool mask of outer loop `nloop` (1 = available)
+        — fault/plan.py `availability`, pure in (plan seed, nloop).
+        The last loop's mask is memoized (purity makes the cache
+        transparent): re-deriving costs O(nloop · N), and the trainer
+        touches each loop's pool twice (the `availability` record and
+        the sampler's draw). Callers must treat the array as
+        read-only."""
+        cached = getattr(self, "_avail_memo", None)
+        if cached is not None and cached[0] == nloop:
+            return cached[1]
+        avail = self.plan.availability(self.n_clients, nloop)
+        self._avail_memo = (nloop, avail)
+        return avail
+
     def speeds_for_round(self, nloop: int, gid: int, nadmm: int) -> np.ndarray:
         """`[nadmm, K]` per-step time multipliers for a whole partition
         round, stacked like `masks_for_round` — pure in (plan seed,
@@ -193,7 +216,7 @@ class FaultInjector:
         nadmm: int,
         exchanges: bool = True,
         total_steps: int | None = None,
-        deadline_s: float | None = None,
+        deadline_s: "float | dict | None" = None,
         cohort=None,
     ) -> dict:
         """Fault counts over the experiment's full round schedule.
@@ -214,7 +237,16 @@ class FaultInjector:
         step count, and `capped_stalls` every straggler stall the
         deadline capped (the host serves `min(delay, deadline)` —
         engine/trainer.py). Both are pure in the plan + deadline, so a
-        resumed run prints the same totals.
+        resumed run prints the same totals. `deadline_s` may be a float
+        (fixed `--round-deadline S`) or a `{(nloop, gid): seconds}`
+        mapping — the auto-deadline policy's per-round decisions
+        (engine/trainer.py `_deadline_for`): pure given the recorded
+        decision history, which the stream replay restores on resume.
+
+        Churn plans add a `churned` row: total client-loop ABSENCES over
+        the experiment (how many (client, loop) pairs sat out of the
+        available pool) — population-level by design, since churn acts
+        on the pool the sampler draws from, not on sampled clients.
 
         Cohort mode (clients/): `cohort` is the sampler's pure
         `nloop -> [C] virtual ids` schedule — only faults landing on a
@@ -223,10 +255,18 @@ class FaultInjector:
         purity keeps the totals resume-proof exactly like the plan's.
         """
         drops = stragglers = crashes = corruptions = 0
-        deadline_misses = capped_stalls = 0
+        deadline_misses = capped_stalls = churned = 0
         for nloop in range(nloops):
             ids = cohort(nloop) if cohort is not None else None
+            if self.plan.has_churn:
+                avail = self.plan.availability(self.n_clients, nloop)
+                churned += int(avail.size - avail.sum())
             for gid in group_order:
+                dl = (
+                    deadline_s.get((nloop, gid))
+                    if isinstance(deadline_s, dict)
+                    else deadline_s
+                )
                 for a in range(nadmm):
                     if exchanges:
                         mask = self.plan.participation(
@@ -244,9 +284,9 @@ class FaultInjector:
                         delay = self.plan.straggler_delay(nloop, gid, a)
                         if delay > 0:
                             stragglers += 1
-                            if deadline_s is not None and delay > deadline_s:
+                            if dl is not None and delay > dl:
                                 capped_stalls += 1
-                        if deadline_s is not None and total_steps:
+                        if dl is not None and total_steps:
                             speeds = self.plan.client_speeds(
                                 self.n_clients, nloop, gid, a
                             )
@@ -256,7 +296,7 @@ class FaultInjector:
                                 speeds,
                                 self.plan.step_time_s,
                                 total_steps,
-                                deadline_s,
+                                dl,
                             )
                             deadline_misses += int(
                                 (budgets < total_steps).sum()
@@ -272,6 +312,8 @@ class FaultInjector:
         if deadline_s is not None:
             counts["deadline_misses"] = deadline_misses
             counts["capped_stalls"] = capped_stalls
+        if self.plan.has_churn:
+            counts["churned"] = churned
         return counts
 
     def straggler_delays_for_round(

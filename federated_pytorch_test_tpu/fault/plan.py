@@ -50,6 +50,24 @@ Plus one SPEED axis (system heterogeneity, not a failure):
   can afford before the deadline — ragged local work inside the round
   program (engine/steps.py), partial updates instead of a stalled
   cohort (docs/FAULT.md §Heterogeneity).
+
+And one CHURN axis (fleet availability, virtual-client populations):
+
+* **churn** — virtual clients leave and rejoin the AVAILABLE POOL per
+  outer loop (`churn=<p>[:mean_absence]`): each loop, every client
+  independently begins an absence with probability `churn_p`, and an
+  absence begun at loop s lasts a geometric number of loops with mean
+  `churn_mean_absence` (the phone that goes offline for a while, not
+  the one that misses a single exchange — that is `dropout`). The
+  cohort sampler (clients/cohort.py) draws only from the available
+  pool, so churn composes with every per-round axis: an absent client
+  is simply never sampled, while a sampled client can still drop,
+  straggle, lie, or run slow. `availability(n_virtual, nloop)` is pure
+  in (seed, nloop) — it re-derives every in-flight absence from the
+  per-loop departure draws, no state threaded across calls — so
+  crashed+resumed runs see the identical pool. The axis only exists
+  over a virtual population (the engine rejects churn plans without
+  `--virtual-clients`: a fixed cross-silo cohort has no pool to leave).
 """
 
 from __future__ import annotations
@@ -88,6 +106,7 @@ SEED_FOLDS = {
     "corruption": 2,
     "speed": 3,
     "cohort": 4,
+    "churn": 5,
 }
 
 
@@ -142,6 +161,13 @@ class FaultPlan:
     slow_k: int = 0
     slow_factor: float = 3.0
     step_time_s: float = 1.0
+    # availability churn over a VIRTUAL population (module docstring):
+    # each outer loop a client begins an absence with probability
+    # `churn_p`; the absence lasts a geometric number of loops with mean
+    # `churn_mean_absence` (>= 1 — an absence shorter than one loop
+    # would be invisible to a per-loop pool).
+    churn_p: float = 0.0
+    churn_mean_absence: float = 2.0
 
     def __post_init__(self):
         # types FIRST, so a wrong-typed field (a JSON plan with
@@ -155,11 +181,13 @@ class FaultPlan:
             "dropout_p", "straggler_p", "straggler_delay_s",
             "corrupt_p", "corrupt_strength",
             "slow_p", "slow_factor", "step_time_s",
+            "churn_p", "churn_mean_absence",
         ):
             v = getattr(self, name)
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError(f"{name} must be a number, got {v!r}")
-        for name in ("dropout_p", "straggler_p", "corrupt_p", "slow_p"):
+        for name in ("dropout_p", "straggler_p", "corrupt_p", "slow_p",
+                     "churn_p"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -196,6 +224,15 @@ class FaultPlan:
             raise ValueError(
                 f"step_time_s must be finite and > 0, got {self.step_time_s}"
             )
+        if not (
+            np.isfinite(self.churn_mean_absence)
+            and self.churn_mean_absence >= 1.0
+        ):
+            # < 1 loop would be an absence the per-loop pool never sees
+            raise ValueError(
+                f"churn_mean_absence must be finite and >= 1, "
+                f"got {self.churn_mean_absence}"
+            )
 
     @property
     def has_corruption(self) -> bool:
@@ -206,6 +243,12 @@ class FaultPlan:
     def has_heterogeneity(self) -> bool:
         """Whether any round of this plan can slow a client down."""
         return self.slow_p > 0.0 or self.slow_k > 0
+
+    @property
+    def has_churn(self) -> bool:
+        """Whether any loop of this plan can remove a client from the
+        available pool."""
+        return self.churn_p > 0.0
 
     # ------------------------------------------------------------- schedule
 
@@ -318,6 +361,41 @@ class FaultPlan:
         speeds[hit] = self.slow_factor
         return speeds
 
+    def availability(self, n_virtual: int, nloop: int) -> np.ndarray:
+        """`[N]` float32 pool mask for outer loop `nloop`: 1 = available.
+
+        Churn is a per-LOOP renewal process: at every loop `s` each
+        client independently begins an absence with probability
+        `churn_p`, whose duration (in loops) is drawn geometric with
+        mean `churn_mean_absence` from the SAME per-loop rng — so a
+        client is absent at loop `t` iff some departure at `s <= t` is
+        still in flight (`s + duration > t`). Overlapping absences
+        union. Pure in (seed, nloop) like every other axis — the
+        in-flight absences are RE-DERIVED from the per-loop draws on
+        every call, no state across calls — on its own seed fold
+        (SEED_FOLDS['churn']), so adding churn to a plan perturbs none
+        of the per-round schedules. Re-deriving costs O(nloop · N);
+        the trainer queries once per loop and the scoreboard once per
+        experiment, both far from hot.
+        """
+        avail = np.ones(n_virtual, np.float32)
+        if not self.has_churn:
+            return avail
+        absent = np.zeros(n_virtual, bool)
+        for s in range(nloop + 1):
+            rng = np.random.default_rng(
+                [fold_seed(self.seed, "churn"), s]
+            )
+            departed = rng.random(n_virtual) < self.churn_p
+            # geometric(p) >= 1 with mean 1/p = churn_mean_absence; the
+            # duration draw happens UNCONDITIONALLY so the departure
+            # mask never changes which stream positions later loops read
+            durations = rng.geometric(1.0 / self.churn_mean_absence,
+                                      n_virtual)
+            absent |= departed & (s + durations > nloop)
+        avail[absent] = 0.0
+        return avail
+
     def crash_at(self, nloop: int, gid: int, nadmm: int) -> CrashPoint | None:
         for c in self.crashes:
             if (c.nloop, c.gid, c.nadmm) == (nloop, gid, nadmm):
@@ -393,6 +471,9 @@ class FaultPlan:
         scale|signflip|nan_burst|gauss. `slow=<k-or-p>[:factor]` (same
         int-vs-float convention) schedules the compute-speed axis, and
         `step_time=<seconds>` sets the simulated nominal per-step time.
+        `churn=<p>[:mean_absence]` schedules per-outer-loop availability
+        churn over a virtual population (p = per-loop departure
+        probability, mean_absence = mean absence length in loops).
         """
         if os.path.exists(spec):
             with open(spec) as f:
@@ -455,10 +536,19 @@ class FaultPlan:
                     kw["slow_factor"] = float(parts[1])
             elif key == "step_time":
                 kw["step_time_s"] = float(val)
+            elif key == "churn":
+                parts = val.split(":")
+                if not 1 <= len(parts) <= 2:
+                    raise ValueError(
+                        f"churn spec {val!r} must be <p>[:mean_absence]"
+                    )
+                kw["churn_p"] = float(parts[0])
+                if len(parts) == 2:
+                    kw["churn_mean_absence"] = float(parts[1])
             else:
                 raise ValueError(
                     f"unknown fault-plan key {key!r} "
                     "(have seed, dropout, straggler, crash, corrupt, "
-                    "slow, step_time)"
+                    "slow, step_time, churn)"
                 )
         return cls(crashes=tuple(crashes), **kw)

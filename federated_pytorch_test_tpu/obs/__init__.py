@@ -29,6 +29,8 @@ Six pillars over the structured metric store (`utils/metrics.py`):
 """
 
 from federated_pytorch_test_tpu.obs.health import (
+    DEADLINE_WARMUP_OBS,
+    DeadlineController,
     HealthEngine,
     P2Quantile,
     PercentileSketch,
@@ -53,6 +55,8 @@ from federated_pytorch_test_tpu.obs.trace import DispatchCounter, TraceRecorder
 __all__ = [
     "CHIP_PEAKS",
     "CommLedger",
+    "DEADLINE_WARMUP_OBS",
+    "DeadlineController",
     "DispatchCounter",
     "HealthEngine",
     "JsonlSink",

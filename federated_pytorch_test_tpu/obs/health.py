@@ -183,6 +183,88 @@ def _median(xs: List[float]) -> float:
     return ys[m] if len(ys) % 2 else 0.5 * (ys[m - 1] + ys[m])
 
 
+# observations the deadline sketch needs before its estimate replaces
+# the warmup constant — the P² initialization threshold (estimates are
+# exact sorted-buffer interpolation below it, but a deadline pinned to
+# one or two early samples would whipsaw the budget schedule)
+DEADLINE_WARMUP_OBS = 5
+
+
+class DeadlineController:
+    """The closed-loop `--round-deadline auto[:pXX]` policy.
+
+    Tracks the SAME online `client_time` signal the health engine
+    sketches — each consensus exchange's cross-client p95 simulated
+    time, the record `engine/trainer.py _record_hetero` streams — in a
+    P² percentile sketch of its own (the controller must work with
+    `--no-health-monitor`, and its quantile is the operator's `pXX`,
+    default p50: ROADMAP item 3's "typical p95" deadline). `decide()`
+    returns the deadline for the NEXT round from the observations
+    already streamed; until the sketch holds `DEADLINE_WARMUP_OBS`
+    observations it returns the warmup constant (the nominal full-work
+    time `total_steps * step_time_s`: nominal-speed clients get full
+    budgets, stragglers already get clipped).
+
+    Purity contract (the replay-identity gate, tests/test_fleet.py):
+    the controller is a pure function of the streamed `client_time`
+    record sequence — wired as a recorder OBSERVER like `HealthEngine`,
+    fed replayed records through `replay()` on resume BEFORE attaching,
+    so a crashed+resumed run re-decides every deadline identically to
+    its uninterrupted twin. Decisions are rounded to 6 digits (like the
+    sketch estimates) so the recorded `deadline` series and the budget
+    arithmetic consume the identical float. The trainer REFUSES to
+    resume an auto-deadline run without a metrics stream to replay —
+    re-estimating the sketch fresh would silently shift every
+    post-resume budget schedule (engine/trainer.py).
+    """
+
+    def __init__(self, quantile: float, warmup_s: float,
+                 min_obs: int = DEADLINE_WARMUP_OBS):
+        if not 0.0 < quantile < 1.0:
+            raise ValueError(
+                f"deadline quantile must be in (0, 1), got {quantile}"
+            )
+        if not (math.isfinite(warmup_s) and warmup_s > 0):
+            raise ValueError(
+                f"deadline warmup must be finite and > 0, got {warmup_s}"
+            )
+        self.quantile = float(quantile)
+        self.warmup_s = float(warmup_s)
+        self.min_obs = int(min_obs)
+        self.sketch = PercentileSketch((self.quantile,))
+
+    # recorder-observer protocol (utils/metrics.py observers)
+    def observe(self, name: str, rec: dict) -> None:
+        if name != "client_time":
+            return
+        v = rec.get("value")
+        if isinstance(v, dict):
+            p95 = v.get("p95")
+            if p95 is not None:
+                self.sketch.update(p95)
+
+    def replay(self, records: Iterable[Tuple[str, dict]]) -> None:
+        """Rebuild sketch state from a resumed stream's replayed records
+        (stream order — the same sequence `observe` saw live)."""
+        for name, rec in records:
+            self.observe(name, rec)
+
+    def decide(self) -> Tuple[float, dict]:
+        """The next round's deadline plus its provenance dict (the
+        `deadline` record value minus the seconds): `source` is
+        'warmup' below `min_obs` observations, else 'sketch'; `n_obs`
+        is the sketch count the decision was taken at."""
+        n = self.sketch.count
+        if n < self.min_obs:
+            return self.warmup_s, {"source": "warmup", "n_obs": n}
+        est = self.sketch.estimates()
+        val = round(float(est[_quantile_key(self.quantile)]), 6)
+        # a degenerate fleet (all-zero times cannot happen — client
+        # times are total*step_time*speed > 0) still must never emit a
+        # non-positive deadline, which config validation forbids
+        return max(val, 1e-9), {"source": "sketch", "n_obs": n}
+
+
 # per-round counter template (one dict per partition round)
 _ROUND_KEYS = (
     "nonfinite", "faults", "rollbacks", "quarantined", "deadline_missed",

@@ -150,16 +150,33 @@ class RunRegistry:
     @staticmethod
     def _run_summary(run: RunStream) -> dict:
         cum_bytes = 0
+        cum_sim_wall = 0.0
         curve: List[dict] = []
         comm_summary = None
         health_records = 0
         health_anomalies = 0
         health_last = None
         exchanges = 0
+        deadlines: List[float] = []
+        deadline_sources: Dict[str, int] = {}
         for series, rec in run.records:
             if series == "comm_bytes":
                 cum_bytes += int(rec["value"])
                 exchanges += 1
+            elif series == "client_time":
+                # each exchange's SIMULATED round wall (the coordinator
+                # closes the round at min(slowest client, deadline) —
+                # engine/trainer.py _record_hetero); cumulative over the
+                # run it is the deadline frontier's time axis
+                v = rec.get("value")
+                if isinstance(v, dict) and v.get("round") is not None:
+                    cum_sim_wall += float(v["round"])
+            elif series == "deadline":
+                v = rec.get("value")
+                if isinstance(v, dict) and v.get("seconds") is not None:
+                    deadlines.append(float(v["seconds"]))
+                    src = str(v.get("source", "fixed"))
+                    deadline_sources[src] = deadline_sources.get(src, 0) + 1
             elif series == "test_accuracy":
                 acc = _mean(rec["value"])
                 curve.append(
@@ -169,6 +186,7 @@ class RunRegistry:
                         "group": rec.get("group"),
                         "nadmm": rec.get("nadmm"),
                         "cum_bytes": cum_bytes,
+                        "cum_sim_wall_s": round(cum_sim_wall, 6),
                         "accuracy": round(acc, 6) if acc is not None else None,
                     }
                 )
@@ -191,8 +209,15 @@ class RunRegistry:
             "evals": len(curve),
             "final_accuracy": final_acc,
             "total_comm_bytes": cum_bytes,
+            "sim_round_wall_total_s": round(cum_sim_wall, 6),
             "curve": curve,
         }
+        if deadlines:
+            summary["deadline"] = {
+                "mean_s": round(sum(deadlines) / len(deadlines), 6),
+                "rounds": len(deadlines),
+                "sources": dict(sorted(deadline_sources.items())),
+            }
         if comm_summary is not None:
             summary["comm"] = {
                 k: comm_summary.get(k)
@@ -210,11 +235,45 @@ class RunRegistry:
         }
         return summary
 
+    @staticmethod
+    def _pareto(points: List[Tuple[str, float, Optional[float]]],
+                cost_key: str) -> List[dict]:
+        """Final-point Pareto frontier over (cost ↓, accuracy ↑): a run
+        is dominated if another reaches >= accuracy at <= cost (strictly
+        better on at least one axis). `cost_key` names the cost field in
+        the emitted rows."""
+        frontier = []
+
+        def _acc(a):
+            return a if a is not None else -1.0
+
+        for name, c, a in sorted(points, key=lambda p: (p[1], p[0])):
+            dominated = any(
+                other != name
+                and oc <= c
+                and _acc(oa) >= _acc(a)
+                and (oc < c or _acc(oa) > _acc(a))
+                for other, oc, oa in points
+            )
+            frontier.append(
+                {
+                    "run": name,
+                    cost_key: c,
+                    "final_accuracy": a,
+                    "pareto": not dominated,
+                }
+            )
+        return frontier
+
     def report(self) -> dict:
         """The full cross-run document: per-run summaries + curves,
-        round-aligned comparison series, and the convergence-vs-bytes
-        frontier. Deterministic (runs sorted by name, no wall-clock
-        content) — twin directories produce byte-identical output."""
+        round-aligned comparison series, the convergence-vs-bytes
+        frontier, and — for runs carrying the simulated-wall evidence
+        (`client_time` records: any deadline or heterogeneous run) —
+        the convergence-vs-deadline frontier (accuracy against total
+        simulated round wall; the ROADMAP-item-3 acceptance surface).
+        Deterministic (runs sorted by name, no wall-clock content) —
+        twin directories produce byte-identical output."""
         if not self.runs:
             raise ValueError("no runs ingested")
         runs = {
@@ -229,35 +288,14 @@ class RunRegistry:
             name: [p["cum_bytes"] for p in s["curve"]]
             for name, s in runs.items()
         }
-        # final-point Pareto frontier over (total bytes ↓, accuracy ↑):
-        # a run is dominated if another reaches >= accuracy with <= bytes
-        # (strictly better on at least one axis)
-        points = [
-            (name, s["total_comm_bytes"], s["final_accuracy"])
-            for name, s in runs.items()
-        ]
-        frontier = []
-
-        def _acc(a):
-            return a if a is not None else -1.0
-
-        for name, b, a in sorted(points, key=lambda p: (p[1], p[0])):
-            dominated = any(
-                other != name
-                and ob <= b
-                and _acc(oa) >= _acc(a)
-                and (ob < b or _acc(oa) > _acc(a))
-                for other, ob, oa in points
-            )
-            frontier.append(
-                {
-                    "run": name,
-                    "total_comm_bytes": b,
-                    "final_accuracy": a,
-                    "pareto": not dominated,
-                }
-            )
-        return {
+        frontier = self._pareto(
+            [
+                (name, s["total_comm_bytes"], s["final_accuracy"])
+                for name, s in runs.items()
+            ],
+            "total_comm_bytes",
+        )
+        doc = {
             "report_version": REPORT_VERSION,
             "runs": runs,
             "aligned": {
@@ -266,6 +304,26 @@ class RunRegistry:
             },
             "frontier": frontier,
         }
+        # the deadline frontier only exists over runs that MEASURED a
+        # simulated wall (deadline or heterogeneous runs); mixing in
+        # wall-less runs at 0.0 would hand them the frontier for free
+        timed = {
+            name: s
+            for name, s in runs.items()
+            if s["sim_round_wall_total_s"] > 0
+        }
+        if timed:
+            rows = []
+            for name, s in timed.items():
+                row = (name, s["sim_round_wall_total_s"],
+                       s["final_accuracy"])
+                rows.append(row)
+            deadline_frontier = self._pareto(rows, "sim_round_wall_s")
+            for p in deadline_frontier:
+                dl = timed[p["run"]].get("deadline")
+                p["deadline_mean_s"] = dl["mean_s"] if dl else None
+            doc["deadline_frontier"] = deadline_frontier
+        return doc
 
 
 def render_markdown(doc: dict) -> str:
@@ -305,6 +363,34 @@ def render_markdown(doc: dict) -> str:
         "`*` = on the frontier: no other run reached at least this "
         "accuracy with at most these bytes."
     )
+    if doc.get("deadline_frontier"):
+        lines += ["", "## Convergence vs deadline frontier", ""]
+        lines.append(
+            "| run | sim round wall (s) | deadline mean (s) | final acc "
+            "| pareto |"
+        )
+        lines.append("|---|---|---|---|---|")
+        for p in doc["deadline_frontier"]:
+            acc = (
+                f"{p['final_accuracy']:.4f}"
+                if p["final_accuracy"] is not None
+                else "-"
+            )
+            dl = (
+                f"{p['deadline_mean_s']:g}"
+                if p.get("deadline_mean_s") is not None
+                else "-"
+            )
+            star = "*" if p["pareto"] else ""
+            lines.append(
+                f"| {p['run']} | {p['sim_round_wall_s']:g} | {dl} "
+                f"| {acc} | {star} |"
+            )
+        lines.append("")
+        lines.append(
+            "`*` = on the frontier: no other run reached at least this "
+            "accuracy in at most this simulated round wall."
+        )
     lines.append("")
     return "\n".join(lines)
 

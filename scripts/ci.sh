@@ -54,7 +54,15 @@
 #                                    combiner, planned crash recovered
 #                                    via rerun — store manifest + stream
 #                                    + cohort sequence all splice, twin
-#                                    stream-identity asserted) and
+#                                    stream-identity asserted),
+#                                    fleet_smoke (the closed loop at 10k
+#                                    virtual clients: churn + speed +
+#                                    corruption plan, --round-deadline
+#                                    auto, telemetry-weighted cohorts,
+#                                    planned crash recovered via rerun
+#                                    with twin stream-compare over the
+#                                    deadline/availability/cohort_weight
+#                                    records) and
 #                                    report_smoke (f32-vs-bf16 codec
 #                                    sweep through the `report` CLI:
 #                                    convergence-vs-bytes frontier with
@@ -385,6 +393,74 @@ assert any(d.get("series") == "cohort_participation" for d in recs)
   rm -rf "$d"
 }
 
+fleet_smoke() {
+  # End-to-end CLOSED-LOOP fleet control through the REAL CLI (the
+  # ROADMAP-item-3 scenario at population scale): 10k virtual clients
+  # with availability churn (churn=0.1:2), Bernoulli 4x stragglers, and
+  # corrupting liars; `--round-deadline auto` tracks the online
+  # client_time sketch, `--cohort-weighting telemetry` steers sampling
+  # by the store's accumulated reliability state, trimmed(1) +
+  # quarantine (with the 2f release rule) defend, and a planned crash
+  # at (nloop=1, gid=2, nadmm=0) kills the first run AFTER loop 0's
+  # scatter committed the telemetry + cohort history. Recovery is
+  # rerunning the IDENTICAL command (--resume auto restores checkpoint,
+  # store, cohort history, and replays the deadline decisions from the
+  # stream); an uninterrupted twin (same plan minus the crash) then
+  # proves crashed+resumed stream identity — deadline, availability,
+  # cohort_weight, and cohort records included.
+  local d; d="$(mktemp -d)"
+  local common=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 320 --synthetic-n-test 60 --batch 20
+    --nloop 3 --nadmm 2 --max-groups 1 --eval-batch 30
+    --virtual-clients 10000 --cohort 8 --data-shards 8 --cohort-seed 11
+    --store-chunk-clients 8 --cohort-weighting telemetry
+    --round-deadline auto
+    --robust-agg trimmed --robust-f 1 --quarantine-z 1.0
+    --save-model --resume auto)
+  local plan="seed=7,churn=0.1:2,slow=0.08:4,corrupt=0.05:scale:10"
+  local cmd=("${common[@]}"
+    --fault-plan "$plan,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${common[@]}"
+    --fault-plan "$plan"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  echo "fleet smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "fleet smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "fleet smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "fleet smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "fleet smoke FAILED: the uninterrupted twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  # the scoreboard's churn row (population client-loop absences) is
+  # pure in the plan, so the resumed run prints a nonzero total
+  grep -Eq '# faults injected: .*churned=[1-9]' "$d/run2.log" || {
+    echo "fleet smoke FAILED: missing/zero churned scoreboard row" >&2
+    grep '# faults' "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+dl = [d for d in recs if d.get("series") == "deadline"]
+assert dl and all(d["value"]["source"] in ("warmup", "sketch") for d in dl)
+assert any(d.get("series") == "availability" for d in recs)
+assert any(d.get("series") == "cohort_weight" for d in recs)
+assert any(d.get("series") == "client_time" for d in recs)
+cohorts = [d for d in recs if d.get("series") == "cohort"]
+assert len(cohorts) == 3 and all(
+    len(d["value"]["clients"]) == 8 for d in cohorts)
+' || {
+    echo "fleet smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "fleet smoke OK"
+  rm -rf "$d"
+}
+
 report_smoke() {
   # End-to-end cross-run registry through the REAL CLI (obs/registry.py,
   # docs/OBSERVABILITY.md): a two-point codec sweep — identical configs
@@ -482,6 +558,7 @@ case "$tier" in
     hetero_smoke
     bf16_smoke
     cohort_smoke
+    fleet_smoke
     report_smoke
     ;;
   all)
@@ -491,6 +568,7 @@ case "$tier" in
     hetero_smoke
     bf16_smoke
     cohort_smoke
+    fleet_smoke
     report_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;

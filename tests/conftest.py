@@ -46,6 +46,35 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 @pytest.fixture(scope="session")
+def norm_stream():
+    """THE twin-stream normalizer (the pytest face of scripts/ci.sh
+    `assert_stream_identity`): parse a JSONL metric stream into records
+    equal modulo wall-clock fields — the `t` stamp, `step_time` seconds
+    — and the header tag (crashed+resumed twins' plans legitimately
+    differ by the fired crash point). Every crash+resume identity test
+    must normalize through this one definition: a wall-clock field added
+    to the stream format is then ignored (or surfaced) everywhere at
+    once instead of by three drifting copies."""
+    import json
+
+    def norm(path):
+        out = []
+        for line in open(path):
+            d = json.loads(line)
+            d.pop("t", None)
+            if d.get("event") == "stream_header":
+                d.pop("tag", None)
+            if d.get("series") == "step_time":
+                d["value"] = {
+                    k: v for k, v in d["value"].items() if k != "seconds"
+                }
+            out.append(d)
+        return out
+
+    return norm
+
+
+@pytest.fixture(scope="session")
 def src_hard_accept():
     """The discriminating acceptance oracle (data/cifar.py): label noise
     + prototype overlap keep accuracy off the ceiling, so robustness or

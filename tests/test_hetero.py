@@ -426,7 +426,9 @@ def test_deadline_rounds_degrade_gracefully(_src_hard):
 
 
 @slow
-def test_crash_resume_stream_identity_with_hetero_records(_src, tmp_path):
+def test_crash_resume_stream_identity_with_hetero_records(
+    _src, tmp_path, norm_stream
+):
     """The stream-identity contract extended to the heterogeneity layer:
     a deadline chaos run killed by a planned crash and resumed yields
     the uninterrupted twin's stream — client_time, step_budget, and
@@ -456,20 +458,7 @@ def test_crash_resume_stream_identity_with_hetero_records(_src, tmp_path):
     assert tr_b2._completed_nloops == 1
     tr_b2.run()
 
-    def norm_stream(path):
-        out = []
-        for line in open(path):
-            d = json.loads(line)
-            d.pop("t", None)
-            if d.get("event") == "stream_header":
-                d.pop("tag")  # the twins' plans differ by the crash point
-            if d.get("series") == "step_time":
-                d["value"] = {
-                    k: v for k, v in d["value"].items() if k != "seconds"
-                }
-            out.append(d)
-        return out
-
+    # the shared twin-stream normalizer (tests/conftest.py norm_stream)
     assert norm_stream(tmp_path / "a.jsonl") == norm_stream(tmp_path / "b.jsonl")
     # the scoreboard's deadline rows are resume-proof too
     inj_a = dict(tr_a.recorder.latest("injected_faults"))

@@ -495,7 +495,9 @@ def test_trimmed_survives_corruption_mean_does_not(
 
 
 @pytest.mark.slow
-def test_crash_resume_stream_identity_with_quarantine_records(_src, tmp_path):
+def test_crash_resume_stream_identity_with_quarantine_records(
+    _src, tmp_path, norm_stream
+):
     """The PR-3/PR-4 stream-identity contract extended to the robust
     layer: a corruption+quarantine chaos run killed by a planned crash
     and resumed yields the uninterrupted twin's stream — quarantine,
@@ -528,20 +530,7 @@ def test_crash_resume_stream_identity_with_quarantine_records(_src, tmp_path):
     assert tr_b2._completed_nloops == 1
     tr_b2.run()
 
-    def norm_stream(path):
-        out = []
-        for line in open(path):
-            d = json.loads(line)
-            d.pop("t", None)
-            if d.get("event") == "stream_header":
-                d.pop("tag")  # the twins' plans differ by the crash point
-            if d.get("series") == "step_time":
-                d["value"] = {
-                    k: v for k, v in d["value"].items() if k != "seconds"
-                }
-            out.append(d)
-        return out
-
+    # the shared twin-stream normalizer (tests/conftest.py norm_stream)
     assert norm_stream(tmp_path / "a.jsonl") == norm_stream(tmp_path / "b.jsonl")
     # the resume-proof chaos scoreboard agrees on everything but the
     # crash the twins differ by (and it never streams — stream identity
@@ -578,13 +567,26 @@ def test_nan_burst_stream_is_strict_json(_src, tmp_path):
     assert any(l.get("series") == "quarantine" for l in lines)
 
 
+@pytest.mark.slow
 def test_comm_ledger_attributes_quarantined_uplink(_src):
     """comm_bytes counts every TRANSMITTING client (a quarantined sender
     doesn't know it's excluded), and the summary attributes the
-    quarantined share as wasted — hand-computed from the suspect series."""
+    quarantined share as wasted — hand-computed from the suspect series.
+    Slow tier (PR-11 wall budget): the zero-waste side of the attribution
+    is gated tier-1 by the quarantine-release test (tests/test_fleet.py)
+    and the stream-level comm contract by tier-2 bf16_smoke.
+
+    MEDIAN combiner on purpose: under trimmed(f) the quarantine-release
+    rule (docs/FAULT.md §Quarantine) un-excludes suspects whenever the
+    trusted cohort would shrink to <= 2f — at K=3 that is every exchange
+    after the first flag, so nothing would ever be wasted and this test
+    would exercise nothing. The release is trimmed-scoped; median keeps
+    the pre-release exclusion semantics this contract is about (the
+    release's own zero-waste accounting is gated in tests/test_fleet.py).
+    """
     cfg = _tiny(
-        fault_plan="seed=7,corrupt=1:scale:10", robust_agg="trimmed",
-        robust_f=1, quarantine_z=1.0, nadmm=3,
+        fault_plan="seed=7,corrupt=1:scale:10", robust_agg="median",
+        quarantine_z=1.0, nadmm=3,
     )
     tr = Trainer(cfg, verbose=False, source=_src)
     tr.run()
