@@ -302,23 +302,48 @@ def _probe_batch_probe():
 
 
 def _exchange_probe(tr_partition, group_order, gid, k):
-    """The bf16 exchange codec's ledger numbers for the measured
-    workload (exchange/, obs/ledger.py): exact uplink bytes of one
-    consensus exchange under `--exchange-dtype bfloat16` — half the f32
-    row — and the partial+codec savings vs the naive full-model f32
-    exchange. Pure partition/codec arithmetic, no device time.
+    """The codec zoo's ledger numbers for the measured workload
+    (exchange/, obs/ledger.py): exact uplink bytes of one consensus
+    exchange under every zoo member — bf16 (half the f32 row), topk at
+    the default keep fraction (index+value pairs), q8 and q4 (scale
+    header + packed levels) — and each member's partial+codec savings
+    vs the naive full-model f32 exchange: the frontier's bytes axis as
+    pure partition/codec arithmetic, no device time. The headline keeps
+    the historical bf16 top-level rows; the zoo lands under "zoo".
     """
+    from federated_pytorch_test_tpu.exchange import make_codec
     from federated_pytorch_test_tpu.obs import CommLedger
 
-    ledger = CommLedger(
-        tr_partition, k, dtype_bytes=4, wire_bytes=2,
-        exchange_dtype="bfloat16",
+    out = {}
+    zoo = {}
+    for name, kw in (
+        ("bf16", dict(exchange_dtype="bfloat16")),
+        ("topk", dict(exchange_codec="topk")),
+        ("q8", dict(exchange_codec="quant", quant_bits=8)),
+        ("q4", dict(exchange_codec="quant", quant_bits=4)),
+    ):
+        codec = make_codec(**kw)
+        ledger = CommLedger(
+            tr_partition, k, dtype_bytes=4,
+            exchange_dtype=kw.get("exchange_dtype", "float32"),
+            codec=codec,
+        )
+        zoo[name] = {
+            "label": codec.label(),
+            "comm_bytes_per_round": ledger.round_bytes(gid, k),
+            "comm_savings_vs_full": round(
+                ledger.savings_vs_full(group_order), 2
+            ),
+        }
+    out.update(
+        {
+            "exchange_dtype": "bfloat16",
+            "comm_bytes_per_round": zoo["bf16"]["comm_bytes_per_round"],
+            "comm_savings_vs_full": zoo["bf16"]["comm_savings_vs_full"],
+            "zoo": zoo,
+        }
     )
-    return {
-        "exchange_dtype": "bfloat16",
-        "comm_bytes_per_round": ledger.round_bytes(gid, k),
-        "comm_savings_vs_full": round(ledger.savings_vs_full(group_order), 2),
-    }
+    return out
 
 
 def _eval_tail_probe():

@@ -26,8 +26,22 @@ probe fans (P=1, the default, is bitwise the sequential search; P>1
 selects the identical step sizes while amortizing the per-probe
 parameter streams), and `--exchange-dtype bfloat16` ships every
 consensus uplink as bf16 — exactly half the ledger bytes; robust
-combiners and quarantine operate on the decoded f32 views. Both are
-trajectory-changing knobs and live in the metrics-stream tag.
+combiners and quarantine operate on the decoded f32 views.
+
+The communication codec zoo + layer-group scheduler (exchange/,
+docs/PERF.md §Codec zoo) moves the bytes frontier further:
+`--exchange-codec topk --topk-fraction f` ships each client's top
+`ceil(f*n)` magnitudes as index+value pairs (~20% of the f32 uplink at
+f=0.1), `--exchange-codec quant --quant-bits 8|4` ships one scale plus
+8/4 bits per value (~25% / ~12.5%), `--error-feedback` carries each
+(client, group)'s compression residual into its next encode, and
+`--group-schedule adaptive` picks WHICH partition group each round
+exchanges from the streamed post-round drift signal —
+`--group-skip-frac F` lets drift-quiet slots send NOTHING at all. The
+ledger records every codec's exact bytes; `report` labels each run's
+frontier point with its codec+scheduler config and sums
+`bytes_saved_by_skipping`. All of these are trajectory-changing knobs
+and live in the metrics-stream tag.
 
 Chaos runs (fault/, docs/FAULT.md) ride the same config surface:
 `--fault-plan "seed=1,dropout=0.3,crash=0:1:2,corrupt=1:scale:10"` (or

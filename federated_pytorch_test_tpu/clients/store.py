@@ -31,10 +31,15 @@ gather → fused round → scatter).
   naive store-in-the-orbax-tree design would rewrite O(N) every loop.
 
 * **Field registry.** A row is a set of named fields — `flat` (the
-  client's parameter vector), one per batch-stats leaf, and one per
+  client's parameter vector), one per batch-stats leaf, one per
   partition group's persistent ADMM rho (`rho/<gid>`, registered lazily
   the first time that group's round completes; see
-  engine/trainer.py `_rho_store`). L-BFGS history and the consensus
+  engine/trainer.py `_rho_store`), one per group's error-feedback
+  residual under a lossy exchange codec (`ef/<gid>`, zero fill —
+  `--error-feedback`, exchange/, docs/PERF.md: the compression error a
+  client's last encode lost follows the VIRTUAL client into its next
+  cohort), and the telemetry reliability counters (`telem/*`,
+  docs/SCALE.md). L-BFGS history and the consensus
   y/z duals are deliberately NOT stored: the engine re-initializes them
   fresh at every partition round by construction (utils/checkpoint.py
   module docstring), so persisting them would be dead weight per client.

@@ -39,6 +39,13 @@ order statistics (a NaN needs no voting to reject — and counting it as
 a cohort member would bias the trim window onto the wrong finite value:
 with 3 survivors and one NaN burst, trimmed(1) would otherwise
 systematically pick the larger honest value instead of their middle).
+The combiners always consume the exchange codec's DECODED f32 views
+(exchange/, engine/steps.py `_consensus_local`) — bf16 widening, topk's
+sparse scatter, quantized levels, error-feedback-compensated sends all
+look like plain f32 vectors here, and the non-finite exclusion is
+exactly what keeps a nan_burst liar visible through every lossy member
+(the topk encoder ranks non-finite magnitudes above everything for the
+same reason: the evidence must reach this code).
 The trim then guards against the plausible-but-wrong values — `trimmed`
 tolerates up to `f` arbitrarily scaled/flipped survivors per round,
 `median` just under half; an exchange whose every update is non-finite

@@ -17,7 +17,13 @@ import re
 from typing import Tuple
 
 from federated_pytorch_test_tpu.consensus import ADMMConfig, ROBUST_METHODS
-from federated_pytorch_test_tpu.exchange import EXCHANGE_DTYPES
+from federated_pytorch_test_tpu.exchange import (
+    EXCHANGE_CODECS,
+    EXCHANGE_DTYPES,
+    GROUP_SCHEDULES,
+    make_codec,
+    validate_group_skip_frac,
+)
 from federated_pytorch_test_tpu.optim import LBFGSConfig
 
 
@@ -206,6 +212,51 @@ class ExperimentConfig:
     # TRAJECTORY-CHANGING (one round-to-nearest-even per exchanged
     # value), so it lives in the metrics-stream tag.
     exchange_dtype: str = "float32"
+
+    # --- codec zoo + layer-group scheduling (exchange/, docs/PERF.md) ---
+    # lossy compression BEYOND the dense dtype members: 'topk' ships each
+    # client's ceil(topk_fraction * group_size) largest-magnitude
+    # coordinates as (index, value) pairs; 'quant' ships one f32 scale
+    # plus quant_bits bits per value (stochastic rounding with a
+    # deterministic per-value dither). None defers to exchange_dtype
+    # (identity / bf16). Mutually exclusive with
+    # exchange_dtype='bfloat16' — one wire compression at a time. The
+    # combiners and quarantine still consume the DECODED f32 views, and
+    # the comm ledger records each codec's exact bytes_on_wire.
+    # TRAJECTORY-CHANGING (like exchange_dtype): stream-tag member.
+    exchange_codec: str | None = None
+    # 'topk' keep fraction in (0, 1] (1.0 keeps everything: dense values
+    # but still index+value wire pricing)
+    topk_fraction: float = 0.1
+    # 'quant' wire width: 8 (q8) or 4 (q4) bits per value
+    quant_bits: int = 8
+    # per-(client, group) error-feedback residual: the sender adds its
+    # carried residual before encoding and keeps (x+e) - decode(encode(
+    # x+e)) for its next exchange of that group — the standard EF
+    # compensation that turns a biased compressor into an unbiased-in-
+    # the-limit one. Carried in the fused round's scan carry, persisted
+    # across outer loops beside the ADMM rho (checkpointed; rides the
+    # ClientStore per virtual client in cohort mode). Requires a LOSSY
+    # codec (exchange_codec set, or exchange_dtype='bfloat16').
+    error_feedback: bool = False
+    # WHICH partition group each round slot exchanges (exchange/
+    # schedule.py): 'roundrobin' is the reference's fixed visit order —
+    # bit-identical to pre-scheduler builds; 'adaptive' picks the
+    # highest-drift unvisited group per slot from the in-scan post-round
+    # per-group distance signal (streamed as `group_distance` every
+    # round, decisions streamed as `group_schedule` and replayed on
+    # resume — resuming an adaptive run REQUIRES a metrics stream, like
+    # auto deadlines). Requires a consensus strategy.
+    group_schedule: str = "roundrobin"
+    # adaptive-only: a TAIL slot whose best remaining group has drifted
+    # to <= this fraction of the run's peak observed drift SENDS
+    # NOTHING (no round runs — zero bytes, recorded as a skipped
+    # group_schedule decision and summed by `report` as
+    # bytes_saved_by_skipping). A loop's FIRST slot never skips — every
+    # loop trains at least one group, so the drift signal refreshes and
+    # an all-quiet state cannot become absorbing (exchange/schedule.py).
+    # 0 disables skipping (adaptive ordering only).
+    group_skip_frac: float = 0.0
 
     # HBM budget for the TRAINING data (MiB). None = the whole dataset is
     # put on device up front (fastest; the default — CIFAR is 150 MB).
@@ -535,6 +586,70 @@ class ExperimentConfig:
             raise ValueError(
                 f"exchange_dtype must be one of {list(EXCHANGE_DTYPES)}, "
                 f"got {self.exchange_dtype!r}"
+            )
+        if self.exchange_codec is not None:
+            if self.exchange_codec not in EXCHANGE_CODECS:
+                raise ValueError(
+                    f"exchange_codec must be one of {list(EXCHANGE_CODECS)} "
+                    f"(or unset for the --exchange-dtype member), got "
+                    f"{self.exchange_codec!r}"
+                )
+            if self.exchange_dtype != "float32":
+                raise ValueError(
+                    "exchange_codec and exchange_dtype='bfloat16' are "
+                    "mutually exclusive: one wire compression at a time "
+                    f"(got exchange_codec={self.exchange_codec!r} with "
+                    f"exchange_dtype={self.exchange_dtype!r})"
+                )
+            # the zoo members OWN their parameter validation
+            # (exchange/codec.py __post_init__ raises naming the field);
+            # constructing the configured member here surfaces it at
+            # config time instead of at the first program build — one
+            # range definition, not a drifting copy
+            make_codec(
+                "float32", self.exchange_codec,
+                self.topk_fraction, self.quant_bits,
+            )
+        # a zoo knob set away from its default without its member active
+        # is a config mistake, not a no-op (the cohort-knob rule above):
+        # the user asked for a compression parameter the wire ignores
+        if self.topk_fraction != 0.1 and self.exchange_codec != "topk":
+            raise ValueError(
+                "topk_fraction requires exchange_codec='topk' "
+                f"(got topk_fraction={self.topk_fraction!r} with "
+                f"exchange_codec={self.exchange_codec!r})"
+            )
+        if self.quant_bits != 8 and self.exchange_codec != "quant":
+            raise ValueError(
+                "quant_bits requires exchange_codec='quant' "
+                f"(got quant_bits={self.quant_bits!r} with "
+                f"exchange_codec={self.exchange_codec!r})"
+            )
+        if self.error_feedback and self.exchange_codec is None and (
+            self.exchange_dtype == "float32"
+        ):
+            raise ValueError(
+                "error_feedback requires a LOSSY codec (exchange_codec "
+                "'topk'/'quant', or exchange_dtype 'bfloat16'): the "
+                "identity wire has no compression error to feed back"
+            )
+        if self.group_schedule not in GROUP_SCHEDULES:
+            raise ValueError(
+                f"group_schedule must be one of {list(GROUP_SCHEDULES)}, "
+                f"got {self.group_schedule!r}"
+            )
+        if self.group_schedule == "adaptive" and self.strategy == "none":
+            raise ValueError(
+                "group_schedule='adaptive' requires a consensus strategy: "
+                "independent training has no exchange to schedule"
+            )
+        # the scheduler owns its range definition (the make_codec
+        # delegation pattern above — exchange/schedule.py)
+        validate_group_skip_frac(self.group_skip_frac)
+        if self.group_skip_frac > 0 and self.group_schedule != "adaptive":
+            raise ValueError(
+                "group_skip_frac requires group_schedule='adaptive' "
+                "(roundrobin never skips a slot)"
             )
         if self.fault_mode not in ("warn", "raise", "rollback", "off"):
             raise ValueError(

@@ -86,7 +86,8 @@ from federated_pytorch_test_tpu.consensus import (
     update_suspects,
 )
 from federated_pytorch_test_tpu.data import normalize
-from federated_pytorch_test_tpu.exchange import get_codec
+from federated_pytorch_test_tpu.exchange import make_codec
+from federated_pytorch_test_tpu.parallel.diagnostics import group_distances
 from federated_pytorch_test_tpu.optim import (
     LBFGSConfig,
     lbfgs_init,
@@ -190,6 +191,27 @@ class GroupContext(NamedTuple):
     # view while clients, master weights, and z stay f32. Static:
     # 'float32' (identity codec) compiles the exact pre-codec program.
     exchange_dtype: str = "float32"
+    # codec-zoo member beyond the dense dtype members (exchange/codec.py
+    # make_codec): 'topk' (fraction below) / 'quant' (bits below) /
+    # None (defer to exchange_dtype). Static like exchange_dtype.
+    exchange_codec: Optional[str] = None
+    topk_fraction: float = 0.1
+    quant_bits: int = 8
+    # per-(client, group) error-feedback residual (docs/PERF.md): the
+    # sender encodes x + e and carries e' = (x+e) - decode(encode(x+e))
+    # to its NEXT exchange of this group. Static — the consensus body
+    # (and the fused round's carry) grow an ef slot only when set, so
+    # EF-free runs compile the exact pre-EF programs. Only meaningful
+    # with a lossy codec (the engine's config validation enforces it;
+    # a hand-built context with an identity codec compiles EF away).
+    error_feedback: bool = False
+    # adaptive layer-group scheduling's in-scan signal (exchange/
+    # schedule.py): the fused round program ends with the shared
+    # `group_distances` body on the final post-round flat and returns
+    # the [num_groups] drift vector as a round output — the one-dispatch
+    # property holds with the signal in-program. Static: roundrobin
+    # runs compile the exact pre-drift programs.
+    group_drift: bool = False
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
@@ -611,17 +633,45 @@ def build_round_init_fn(ctx: GroupContext, mesh, counter=None):
     return _counted(jax.jit(sharded), counter, "round_init")
 
 
+def _wire_codec(ctx: GroupContext):
+    """The context's exchange codec (exchange/codec.py make_codec — the
+    ONE config-to-codec mapping, shared with the trainer's ledger)."""
+    return make_codec(
+        ctx.exchange_dtype, ctx.exchange_codec,
+        ctx.topk_fraction, ctx.quant_bits,
+    )
+
+
+def _ef_enabled(ctx: GroupContext) -> bool:
+    """Whether the consensus programs carry the error-feedback residual.
+
+    ONE definition (the `_corruption_enabled` rule): this predicate
+    fixes the compiled programs' argument/carry/output signature AND
+    gates every call site's ef argument — a drifted copy would be an
+    argument-count mismatch at dispatch. EF only exists where a LOSSY
+    exchange does: identity-codec or strategy-'none' contexts compile
+    the exact pre-EF programs whatever the flag says.
+    """
+    return (
+        ctx.error_feedback
+        and ctx.strategy != "none"
+        and not _wire_codec(ctx).is_identity
+    )
+
+
 def _consensus_local(ctx: GroupContext):
     """The per-device consensus body, shared by the standalone consensus
     program (`build_consensus_fn`) and the fused round (`build_round_fn`).
 
-    `(flat, y, z, rho, extra, nadmm, mask[, cmode, cstr, cseed]) ->
+    `(flat, y, z, rho, extra, nadmm, mask[, ef][, cmode, cstr, cseed]) ->
     (flat, y, z, rho, extra, (dual, primal, mean_rho, survivors),
-    qstats)`. The corruption args exist only when `ctx.corrupt` (the
-    plan schedules update corruption — static, so corruption-free runs
-    compile the pre-corruption program); `qstats` is `(unorm, suspect)`
-    — the auto-quarantine update-norm statistics — when
-    `ctx.quarantine_z` is set, else `()`. `mask` is the EFFECTIVE
+    qstats, ef')`. The `ef` slot exists only when `_ef_enabled(ctx)`
+    (the per-(client, group) error-feedback residual `[K_loc, G]`; `ef'`
+    is `()` otherwise); the corruption args only when `ctx.corrupt`
+    (the plan schedules update corruption — static, so corruption-free
+    runs compile the pre-corruption program); `qstats` is
+    `(unorm, suspect)` — the auto-quarantine update-norm statistics —
+    when `ctx.quarantine_z` is set, else `()`. `mask` is the EFFECTIVE
     participation vector (plan dropout AND any quarantine accumulated by
     the caller). Returns None for strategy 'none' (independent training
     has no consensus exchange).
@@ -629,34 +679,63 @@ def _consensus_local(ctx: GroupContext):
     if ctx.strategy == "none":
         return None
     quarantine = ctx.quarantine_z is not None
-    codec = get_codec(ctx.exchange_dtype)
+    codec = _wire_codec(ctx)
     # static: the identity codec compiles the exact pre-codec program
     wire = not codec.is_identity
+    ef_on = _ef_enabled(ctx)
 
-    def send_view(x, corr):
-        """The aggregation's view of the updates: what the exchange
-        RECEIVED. The sender encodes its group slice through the wire
-        codec (exchange/ — decode back to f32 models the receiver's
-        view; identity is a no-op compiled away), and an in-transit
-        corruption fault garbles the wire AFTER the encoder (mode 0
-        selects the bits verbatim). Every consumer downstream — mean,
-        robust combiners, quarantine statistics — sees decoded f32."""
+    def send_view(x, ef, mask, corr):
+        """The aggregation's view of the updates (what the exchange
+        RECEIVED) plus the sender's next error-feedback residual.
+
+        The sender adds its carried residual (error feedback — the
+        compensation that keeps a lossy codec's bias from accumulating),
+        encodes through the wire codec (exchange/ — decode back to f32
+        models the receiver's view; identity is a no-op compiled away),
+        and keeps what the wire lost. An in-transit corruption fault
+        garbles the wire AFTER the encoder (and after the sender's EF
+        bookkeeping — the sender doesn't know its link is hostile; mode
+        0 selects the bits verbatim). The residual only updates for
+        clients IN the exchange (`mask`): a dropped / zero-budget /
+        still-quarantined client never transmitted, so it carries its
+        residual unchanged — and a non-finite residual (poisoned
+        sender) resets to zero rather than wedging every later wire.
+        Every consumer downstream — mean, robust combiners, quarantine
+        statistics — sees decoded f32."""
+        ef_new = ()
         if wire:
-            x = codec.roundtrip(x)
-        if not ctx.corrupt:
-            return x
-        return apply_corruption(x, *corr, gauss=ctx.corrupt_gauss)
+            x_comp = x + ef if ef_on else x
+            sent = codec.roundtrip(x_comp)
+            if ef_on:
+                resid = x_comp - sent
+                resid = jnp.where(jnp.isfinite(resid), resid, 0.0)
+                ef_new = jnp.where(mask[:, None] > 0, resid, ef)
+        else:
+            sent = x
+        if ctx.corrupt:
+            sent = apply_corruption(sent, *corr, gauss=ctx.corrupt_gauss)
+        return sent, ef_new
 
     def qstats_of(x_send, z_prev, mask):
         if not quarantine:
             return ()
         return update_suspects(x_send, z_prev, mask, ctx.quarantine_z)
 
+    def parse_rest(rest):
+        """THE one `*rest` layout of the consensus body — [ef] when
+        error feedback is carried, then the corruption rows. Positional
+        and order-sensitive, so both strategy branches (and any future
+        optional slot) must unpack through this single definition."""
+        rest = list(rest)
+        ef = rest.pop(0) if ef_on else ()
+        return ef, tuple(rest)
+
     if ctx.strategy == "fedavg":
 
-        def local(flat, y, z, rho, extra, nadmm, mask, *corr):
+        def local(flat, y, z, rho, extra, nadmm, mask, *rest):
+            ef, corr = parse_rest(rest)
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
-            x_send = send_view(x, corr)
+            x_send, ef_new = send_view(x, ef, mask, corr)
             state, met = fedavg_round(
                 x_send,
                 FedAvgState(z=z),
@@ -678,13 +757,14 @@ def _consensus_local(ctx: GroupContext):
                 zeros,
                 zeros,
                 met["survivors"],
-            ), qstats_of(x_send, z, mask)
+            ), qstats_of(x_send, z, mask), ef_new
 
     else:  # admm
 
-        def local(flat, y, z, rho, extra, nadmm, mask, *corr):
+        def local(flat, y, z, rho, extra, nadmm, mask, *rest):
+            ef, corr = parse_rest(rest)
             x = jax.vmap(lambda f: ctx.partition.extract(f, ctx.gid))(flat)
-            x_send = send_view(x, corr)
+            x_send, ef_new = send_view(x, ef, mask, corr)
             yhat0, x0 = extra
             state = ADMMState(y=y, z=z, rho=rho, yhat0=yhat0, x0=x0)
             state, met = admm_round(
@@ -706,7 +786,7 @@ def _consensus_local(ctx: GroupContext):
                 met.primal_residual,
                 met.mean_rho,
                 met.survivors,
-            ), qstats_of(x_send, z, mask)
+            ), qstats_of(x_send, z, mask), ef_new
 
     return local
 
@@ -727,21 +807,27 @@ def build_consensus_fn(ctx: GroupContext, mesh, counter=None):
     from stale parameters — the partial-participation regime of TAMUNA
     (arXiv:2302.09832). Metrics gain the psum'd survivor count.
 
-    With `ctx.corrupt` the signature grows the round's `[K]` corruption
-    mode/strength/seed rows (fault/injector.py) and the exchange consumes
-    the in-transit-corrupted updates; with `ctx.quarantine_z` the
-    returned `qstats` tuple carries the `[K]` update norms and suspect
-    flags the trainer folds into the NEXT exchange's mask
-    (consensus/robust.py; both empty/absent otherwise — the clean
-    program is unchanged).
+    With `_ef_enabled(ctx)` the signature grows the `[K, G]`
+    error-feedback residual after `mask` and the outputs gain the
+    updated residual (the trainer carries it across exchanges and outer
+    loops — `engine/trainer.py _ef_store`). With `ctx.corrupt` the
+    signature grows the round's `[K]` corruption mode/strength/seed rows
+    (fault/injector.py) and the exchange consumes the
+    in-transit-corrupted updates; with `ctx.quarantine_z` the returned
+    `qstats` tuple carries the `[K]` update norms and suspect flags the
+    trainer folds into the NEXT exchange's mask (consensus/robust.py;
+    all empty/absent otherwise — the clean program is unchanged).
     """
     local = _consensus_local(ctx)
     if local is None:
         return None
+    ef_on = _ef_enabled(ctx)
 
     c = P(CLIENT_AXIS)
     r = P()
     in_specs = (c, c, r, c, (c, c), r, c)
+    if ef_on:
+        in_specs = in_specs + (c,)
     if ctx.corrupt:
         in_specs = in_specs + (c, c, c)
     qspec = (c, c) if ctx.quarantine_z is not None else ()
@@ -749,7 +835,10 @@ def build_consensus_fn(ctx: GroupContext, mesh, counter=None):
         local,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(c, c, r, c, (c, c), (r, r, r, r), qspec),
+        out_specs=(
+            c, c, r, c, (c, c), (r, r, r, r), qspec,
+            c if ef_on else (),
+        ),
         check_vma=True,
     )
     # no donation here: the round-init placeholders alias buffers (e.g.
@@ -822,6 +911,7 @@ def build_round_fn(
        shard_labels [K,n], idx [nadmm, nepoch, S, K, B],
        mean [K], std [K], y [K,G], z [G], rho [K,1], extra,
        masks [nadmm, K]
+       [, ef0 [K, G] — static `_ef_enabled(ctx)` only]
        [, budgets [nadmm, K] i32 — static `ctx.ragged` only]
        [, cmodes [nadmm, K] i32, cstrengths [nadmm, K], cseeds
           [nadmm, K] i32 — static `ctx.corrupt` only]
@@ -831,7 +921,7 @@ def build_round_fn(
           losses [nadmm, nepoch, S, K],
           met (dual, primal, mean_rho, survivors) each [nadmm],
           param_ok [nadmm, K] bool,
-          qstats, snaps, correct)
+          qstats, snaps, correct, ef [K, G], drift [num_groups])
 
     * `idx` is the whole round's shuffle schedule, precomputed host-side
       (the trainer stacks its deterministic per-(nadmm, epoch)
@@ -883,6 +973,18 @@ def build_round_fn(
       launches, no mid-round `[nadmm, K, N]` state snapshots
       materialized. `snapshot` and `fold_eval` are mutually exclusive
       (folding replaces the snapshot consumer).
+    * `ef` (static `_ef_enabled(ctx)` only, else `()`): the round's
+      final per-(client, group) error-feedback residual — `ef0` carried
+      through every consensus exchange of the scan (a residual the
+      codec lost at exchange a compensates at exchange a+1 WITHIN the
+      one dispatch); the trainer persists it to the next outer loop.
+    * `drift` (static `ctx.group_drift` only, else `()`): the
+      `[num_groups]` post-round per-group drift signal — the SHARED
+      `parallel/diagnostics.py group_distances` body on the final flat,
+      inside the same dispatch (the standalone program the unfused path
+      dispatches runs the identical ops, the `_client_eval_fn` sharing
+      pattern) — what the adaptive layer-group scheduler consumes
+      (exchange/schedule.py).
 
     `nadmm`/`nepoch` are static (they shape the scan); donation matches
     `build_epoch_fn` (flat/lstate/stats update in place; the test sweep
@@ -919,14 +1021,17 @@ def build_round_fn(
         else None
     )
     ragged = ctx.ragged
+    ef_on = _ef_enabled(ctx)
+    drift_on = ctx.group_drift
 
     def local(flat, lstate, stats, shard_imgs, shard_labels, idx, mean, std,
               y, z, rho, extra, masks, *rest):
-        # *rest, by static flags: [budgets] when the round is ragged,
-        # then [cmodes, cstrengths, cseeds] when the plan schedules
-        # corruption, then [test_imgs, test_labels, test_mask] when the
-        # eval is folded
+        # *rest, by static flags: [ef0] when error feedback is carried,
+        # then [budgets] when the round is ragged, then [cmodes,
+        # cstrengths, cseeds] when the plan schedules corruption, then
+        # [test_imgs, test_labels, test_mask] when the eval is folded
         rest = list(rest)
+        ef0 = rest.pop(0) if ef_on else ()
         budget_rows = rest.pop(0) if ragged else ()
         corr_rows = tuple(rest[:3]) if corrupt else ()
         if corrupt:
@@ -936,7 +1041,7 @@ def build_round_fn(
         )
 
         def round_body(carry, xs):
-            flat, lstate, stats, y, z, rho, extra, qmask, lloss = carry
+            flat, lstate, stats, y, z, rho, extra, qmask, lloss, ef = carry
             # [nepoch, S, K_loc, B], [K_loc], i32, per-iteration [K_loc]
             # budget and corruption rows
             idx_a, mask_a, na, budget_a, corr_a = xs
@@ -1010,9 +1115,12 @@ def build_round_fn(
                         )
                     else:
                         eff_mask = gated
-                flat, y, z, rho, extra, met, qstats = consensus_local(
-                    flat, y, z, rho, extra, na, eff_mask, *corr_a
+                ef_args = (ef,) if ef_on else ()
+                flat, y, z, rho, extra, met, qstats, ef_new = consensus_local(
+                    flat, y, z, rho, extra, na, eff_mask, *ef_args, *corr_a
                 )
+                if ef_on:
+                    ef = ef_new
             else:
                 zeros = jnp.zeros((), flat.dtype)
                 met = (zeros, zeros, zeros, zeros)
@@ -1034,7 +1142,9 @@ def build_round_fn(
                     client_eval, in_axes=(0, 0, None, None, None, 0, 0)
                 )(flat, stats, test_imgs, test_labels, test_mask, mean, std)
                 ys = ys + (correct,)
-            return (flat, lstate, stats, y, z, rho, extra, qmask, lloss), ys
+            return (
+                flat, lstate, stats, y, z, rho, extra, qmask, lloss, ef
+            ), ys
 
         # the quarantine carry starts all-clear; derived from the varying
         # masks input so its vma type matches the suspect-driven updates
@@ -1043,7 +1153,9 @@ def build_round_fn(
         # until its first active step of the round); vma_zero keeps the
         # varying type the per-client selects produce
         lloss0 = vma_zero(mean) if ragged else ()
-        carry = (flat, lstate, stats, y, z, rho, extra, qmask0, lloss0)
+        carry = (
+            flat, lstate, stats, y, z, rho, extra, qmask0, lloss0, ef0
+        )
         na_seq = jnp.arange(nadmm, dtype=jnp.int32)
         # corr_rows (and budget_rows) are () when their static flag is
         # off — a leafless xs entry whose per-step slice stays (), so one
@@ -1051,15 +1163,20 @@ def build_round_fn(
         carry, ys = lax.scan(
             round_body, carry, (idx, masks, na_seq, budget_rows, corr_rows)
         )
-        flat, lstate, stats, y, z, rho, extra, _, _ = carry
+        flat, lstate, stats, y, z, rho, extra, _, _, ef_out = carry
         losses, met, param_ok = ys[:3]
         i = 3
         qstats = (ys[i][0], ys[i][1]) if quarantine else ()
         i += 1 if quarantine else 0
         snaps = ys[i] if snapshot else ()
         correct = ys[-1] if fold_eval else ()
+        # the adaptive scheduler's in-scan signal: the SHARED
+        # group_distances body on the round's final parameters — one
+        # psum, replicated [num_groups] output, same dispatch
+        drift = group_distances(flat, ctx.partition) if drift_on else ()
         return (flat, lstate, stats, y, z, rho, extra,
-                losses, met, param_ok, qstats, snaps, correct)
+                losses, met, param_ok, qstats, snaps, correct,
+                ef_out, drift)
 
     c = P(CLIENT_AXIS)
     r = P()
@@ -1070,6 +1187,8 @@ def build_round_fn(
         c, c, c, r, c, (c, c),
         sc1,  # masks [nadmm, K]
     )
+    if ef_on:
+        in_specs = in_specs + (c,)  # error-feedback residual [K, G]
     if ragged:
         in_specs = in_specs + (sc1,)  # step budgets [nadmm, K]
     if corrupt:
@@ -1084,6 +1203,8 @@ def build_round_fn(
         (sc1, sc1) if quarantine else (),  # update norms + suspect flags
         (sc1, sc1) if snapshot else (),  # post-consensus state snapshots
         sc1 if fold_eval else (),  # folded-eval correct counts [nadmm, K]
+        c if ef_on else (),  # final error-feedback residual [K, G]
+        r if drift_on else (),  # post-round drift signal [num_groups]
     )
     sharded = shard_map(
         local,

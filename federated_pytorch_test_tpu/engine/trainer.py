@@ -48,7 +48,7 @@ from federated_pytorch_test_tpu.data import (
     virtual_shard_assignment,
 )
 from federated_pytorch_test_tpu.engine.config import ExperimentConfig
-from federated_pytorch_test_tpu.exchange import get_codec
+from federated_pytorch_test_tpu.exchange import GroupScheduler, make_codec
 from federated_pytorch_test_tpu.engine.steps import (
     GroupContext,
     build_consensus_fn,
@@ -415,6 +415,22 @@ class Trainer:
         # a layer carry over to its next visit; y/z/yhat are re-zeroed per
         # round (reference :281-302) and are not stored
         self._rho_store: Dict[int, Any] = {}
+        # per-(group, client) error-feedback residual (`--error-feedback`,
+        # exchange/, docs/PERF.md): what the lossy wire codec lost at the
+        # client's LAST exchange of a group, added back before its next
+        # encode. Same lifecycle as rho — persistent across outer loops,
+        # checkpointed, rolled back with a poisoned round, and carried
+        # per VIRTUAL client through the ClientStore in cohort mode
+        # (`ef/<gid>` fields, registered at the group's first scatter).
+        self._ef_store: Dict[int, Any] = {}
+        # adaptive layer-group scheduling (exchange/schedule.py): which
+        # partition group each round slot runs — decided at slot start
+        # from the streamed per-round drift signal, memoized here (and
+        # streamed as `group_schedule`). roundrobin leaves all of this
+        # machinery off: the legacy fixed order, bit-identical streams.
+        self._adaptive = cfg.group_schedule == "adaptive"
+        self._scheduler = None
+        self._schedule_decisions: Dict[tuple, dict] = {}
 
         # fault injection (fault/): replayable chaos — per-round dropout
         # masks, straggler stalls, planned crash points. The all-ones mask
@@ -459,16 +475,27 @@ class Trainer:
         # its truncation point is the restored loop cursor.
         self._dispatch = DispatchCounter()
         self._diag_fn = None  # jitted group_distances, built on first use
-        # the ledger counts WIRE bytes (exchange/ codec — half per value
-        # under bf16) against the full-model PARAMETER-width baseline
+        # the ledger counts WIRE bytes (exchange/ codec zoo — the codec's
+        # exact bytes_on_wire: half per value under bf16, index+value
+        # pairs under topk, scale header + packed levels under quant)
+        # against the full-model PARAMETER-width baseline. THE codec
+        # instance is shared with the consensus body's build
+        # (steps.py _wire_codec uses the same make_codec mapping), so
+        # the program and the ledger cannot disagree about the wire.
         wire_dtype = cfg.exchange_dtype if cfg.strategy != "none" else "float32"
+        self._wire_codec = make_codec(
+            wire_dtype,
+            cfg.exchange_codec if cfg.strategy != "none" else None,
+            cfg.topk_fraction,
+            cfg.quant_bits,
+        )
         self._comm = CommLedger(
             self.partition,
             cfg.n_clients,
             dtype_bytes=int(jnp.dtype(self.flat.dtype).itemsize),
             data_floor_bytes=int(data_bytes),
-            wire_bytes=get_codec(wire_dtype).bytes_per_value,
             exchange_dtype=wire_dtype,
+            codec=self._wire_codec,
         )
         if cfg.trace_out and jax.process_index() == 0:
             self.recorder.tracer = TraceRecorder()
@@ -549,6 +576,34 @@ class Trainer:
                         (int(rec["nloop"]), int(rec["group"]))
                     ] = float(rec["value"]["seconds"])
             self.recorder.observers.append(self._deadline_ctl)
+        # adaptive layer-group scheduler (exchange/schedule.py): a pure
+        # observer of the streamed per-round `group_distance` signal,
+        # replayed BEFORE attaching exactly like the deadline controller;
+        # per-slot decisions are memoized in `_schedule_decisions` (and
+        # streamed as `group_schedule`), with replayed decisions seeding
+        # the memo so a resumed run re-runs the crashed loop's slots
+        # identically instead of re-deciding from a shifted signal.
+        if self._adaptive:
+            self._scheduler = GroupScheduler(
+                self.group_order, skip_frac=cfg.group_skip_frac
+            )
+            if self._completed_nloops and not replay:
+                raise ValueError(
+                    "resuming under --group-schedule adaptive requires "
+                    "the run's --metrics-stream: past slot decisions and "
+                    "the drift signal they consumed are replayed from "
+                    "the stream, never re-estimated fresh (a cold "
+                    "scheduler would silently reorder every post-resume "
+                    "round)"
+                )
+            if replay:
+                self._scheduler.replay(replay)
+                for rec in self.recorder.series.get("group_schedule", []):
+                    v = rec["value"]
+                    self._schedule_decisions[
+                        (int(rec["nloop"]), int(v["slot"]))
+                    ] = dict(v)
+            self.recorder.observers.append(self._scheduler)
         # AOT round-program cost analysis (obs/roofline.py), stashed by
         # compile_round per group: feeds the end-of-run `roofline` record.
         # Replayed step_time records are the CRASHED process's walls —
@@ -754,6 +809,13 @@ class Trainer:
             exchange_dtype=(
                 cfg.exchange_dtype if cfg.strategy != "none" else "float32"
             ),
+            exchange_codec=(
+                cfg.exchange_codec if cfg.strategy != "none" else None
+            ),
+            topk_fraction=cfg.topk_fraction,
+            quant_bits=cfg.quant_bits,
+            error_feedback=self._ef_enabled(),
+            group_drift=self._adaptive,
         )
 
     def _quarantine_enabled(self) -> bool:
@@ -787,6 +849,31 @@ class Trainer:
         if release_2f is not None and gated.sum() <= release_2f:
             return transmit_np, 0
         return gated, int((transmit_np * (1.0 - qmask_np)).sum())
+
+    def _ef_enabled(self) -> bool:
+        """Whether the consensus programs carry the error-feedback
+        residual (steps.py `_ef_enabled` applies the same rule to the
+        built context — ONE signature-fixing predicate per mechanism,
+        the `_corruption_enabled` discipline). Config validation already
+        requires a lossy codec; the strategy gate mirrors the codec's
+        (no exchange, no wire, no residual)."""
+        return self.cfg.error_feedback and self.cfg.strategy != "none"
+
+    def _ef_for(self, gid: int):
+        """The round's entry error-feedback residual `[K, group_size]`
+        for `gid` — the persisted carry, or fresh zeros at the group's
+        first-ever exchange (cohort mode gathers the cohort's rows at
+        `_begin_loop_cohort` instead)."""
+        ef = self._ef_store.get(gid)
+        if ef is None:
+            ef = self._put(
+                np.zeros(
+                    (self.cfg.n_clients, self.partition.group_size(gid)),
+                    np.float32,
+                ),
+                client_sharding(self.mesh),
+            )
+        return ef
 
     def _corruption_enabled(self) -> bool:
         """Whether the consensus programs carry the corruption inputs.
@@ -959,14 +1046,18 @@ class Trainer:
             return arr
         return np.asarray(arr)[..., self.sampler.cohort(nloop)]
 
-    def _rho_gids(self) -> list:
-        """Partition groups with a persistent per-virtual-client rho
-        field in the store (registered at the group's first scatter)."""
+    def _store_gids(self, prefix: str) -> list:
+        """Partition groups with a persistent per-virtual-client field
+        of `prefix` ('rho' / 'ef') in the store (registered at the
+        group's first scatter)."""
         return [
             int(name.split("/", 1)[1])
             for name in self.store.fields
-            if name.startswith("rho/")
+            if name.startswith(prefix + "/")
         ]
+
+    def _rho_gids(self) -> list:
+        return self._store_gids("rho")
 
     # per-virtual-client reliability counters (telemetry-steered
     # cohorts): scalar store fields, one row per client, accumulated at
@@ -1028,6 +1119,11 @@ class Trainer:
         Speeds, drops, and budgets are re-derived from the pure plan
         (and the loop's memoized deadline decisions); quarantines come
         from the per-loop accumulator `_record_quarantine` maintains.
+        Under the adaptive group schedule only rounds that actually RAN
+        count (`_loop_visited_gids` — a dropout scheduled into a
+        skipped slot never happened, and penalizing the client for it
+        would skew the sampler; same rule as `injected_summary`'s
+        visits).
         """
         cfg = self.cfg
         c = ids.size
@@ -1036,7 +1132,7 @@ class Trainer:
         misses = np.zeros(c, np.float32)
         drops = np.zeros(c, np.float32)
         total = self._round_total_steps()
-        for gid in self.group_order:
+        for gid in self._loop_visited_gids(nloop):
             if cfg.strategy == "none":
                 break  # no exchange: nothing to be reliable AT
             speeds, budgets, _ = self._round_hetero(nloop, gid)
@@ -1119,6 +1215,16 @@ class Trainer:
                 )
                 for gid in self._rho_gids()
             }
+            # error-feedback residuals follow the VIRTUAL client like
+            # rho: a client's uncompensated compression error rejoins it
+            # in whatever cohort slot it lands in (pristine rows gather
+            # the zero fill — a first-ever exchange has lost nothing)
+            self._ef_store = {
+                gid: _owned_copy(
+                    self._put(self.store.gather(f"ef/{gid}", ids), csh)
+                )
+                for gid in self._store_gids("ef")
+            }
             shards = self.store.shard_ids[ids]
             self.shard_imgs = self._put(self.fed.train_images[shards], csh)
             self.shard_labels = self._put(self.fed.train_labels[shards], csh)
@@ -1145,7 +1251,10 @@ class Trainer:
         """
         ids = self._cohort_ids
         stats_leaves = jax.tree.leaves(self.stats)
-        for arr in (self.flat, *stats_leaves, *self._rho_store.values()):
+        for arr in (
+            self.flat, *stats_leaves,
+            *self._rho_store.values(), *self._ef_store.values(),
+        ):
             try:
                 arr.copy_to_host_async()
             except AttributeError:
@@ -1172,6 +1281,16 @@ class Trainer:
                         ),
                     )
                 self.store.scatter(name, ids, rho_np)
+            for gid, ef in sorted(self._ef_store.items()):
+                ef_np = self._fetch(ef)
+                name = f"ef/{gid}"
+                if not self.store.has_field(name):
+                    # pristine clients of later cohorts gather a ZERO
+                    # residual — their first exchange has lost nothing
+                    self.store.register_field(
+                        name, np.zeros(ef_np.shape[1:], ef_np.dtype)
+                    )
+                self.store.scatter(name, ids, ef_np)
             if self.cfg.cohort_weighting == "telemetry":
                 # reliability counters ride the same scatter-side commit
                 # discipline as the state rows: a loop that crashes
@@ -1666,6 +1785,7 @@ class Trainer:
                     np.ones((self.cfg.nadmm, self.cfg.n_clients), np.float32),
                     sh,
                 )
+                ef_args = (self._ef_for(gid),) if self._ef_enabled() else ()
                 budget_args = ()
                 if self._ragged_enabled():
                     budget_args = (
@@ -1694,8 +1814,8 @@ class Trainer:
                 compiled = round_fn.lower(
                     self.flat, lstate, self.stats, self.shard_imgs,
                     self.shard_labels, idx, self.mean, self.std,
-                    y, z, rho, extra, masks, *budget_args, *corr_args,
-                    *eval_args,
+                    y, z, rho, extra, masks, *ef_args, *budget_args,
+                    *corr_args, *eval_args,
                 ).compile()
                 self._stash_round_cost(gid, compiled)
                 return time.perf_counter() - t0
@@ -1728,6 +1848,7 @@ class Trainer:
                     *ragged_args,
                 ).compile()
             if consensus_fn is not None:
+                ef_args = (self._ef_for(gid),) if self._ef_enabled() else ()
                 corr_args = ()
                 if ctx_corrupt:
                     csh = client_sharding(self.mesh)
@@ -1739,7 +1860,7 @@ class Trainer:
                     )
                 consensus_fn.lower(
                     self.flat, y, z, rho, extra, jnp.int32(0),
-                    self._full_mask, *corr_args,
+                    self._full_mask, *ef_args, *corr_args,
                 ).compile()
             return time.perf_counter() - t0
 
@@ -1778,6 +1899,11 @@ class Trainer:
             _owned_copy(self._rho_store[gid])
             if gid in self._rho_store
             else None,
+            # the error-feedback residual is round state like rho: a
+            # rolled-back round's compression errors never happened
+            _owned_copy(self._ef_store[gid])
+            if gid in self._ef_store
+            else None,
         )
 
     def _maybe_rollback(self, snap, nloop: int, gid: int) -> None:
@@ -1796,13 +1922,17 @@ class Trainer:
         if not self._round_poisoned:
             return
         self.recorder.discard_pending("test_accuracy")
-        snap_flat, snap_stats, snap_rho = snap
+        snap_flat, snap_stats, snap_rho, snap_ef = snap
         self.flat = snap_flat
         self.stats = snap_stats
         if snap_rho is not None:
             self._rho_store[gid] = snap_rho
         else:
             self._rho_store.pop(gid, None)
+        if snap_ef is not None:
+            self._ef_store[gid] = snap_ef
+        else:
+            self._ef_store.pop(gid, None)
         self.recorder.fault("round_rollback", [], nloop=nloop, group=gid)
         self._round_poisoned = False
 
@@ -1851,9 +1981,16 @@ class Trainer:
         # the diagnostics sample runs BEFORE the delta is taken, so its
         # dispatch (and first-use compile) land in THIS round's
         # dispatch_count/recompile_count instead of falling between
-        # every delta window
+        # every delta window. The adaptive scheduler SUPERSEDES the
+        # cadence: it already records `group_distance` every round from
+        # the in-scan signal (exchange/schedule.py), so sampling again
+        # here would duplicate records and (fused) waste a dispatch.
         every = self.cfg.diagnostics_every
-        if every is not None and self._rounds_done % every == 0:
+        if (
+            every is not None
+            and not self._adaptive
+            and self._rounds_done % every == 0
+        ):
             self._record_group_distances(nloop, gid)
         self.recorder.log(
             "dispatch_count",
@@ -1928,6 +2065,10 @@ class Trainer:
         quarantine = self._quarantine_enabled()
         ragged = self._ragged_enabled()
         hetero = self._hetero_enabled()
+        ef_on = self._ef_enabled()
+        # the error-feedback residual carried across this round's
+        # exchanges (the fused path threads the same carry in-scan)
+        ef = self._ef_for(gid) if ef_on else None
         total_steps = self._round_total_steps()
         s_epoch = self.fed.steps_per_epoch(cfg.batch)
         budgets_m = times_m = None
@@ -2112,13 +2253,17 @@ class Trainer:
                         self._put(cs, csh),
                         self._put(csd, csh),
                     )
+                ef_args = (ef,) if ef_on else ()
                 with self.recorder.phase(
                     "consensus", nloop=nloop, group=gid, nadmm=nadmm
                 ), jax.profiler.TraceAnnotation("consensus"):
-                    self.flat, y, z, rho, extra, met, qstats = consensus_fn(
+                    (self.flat, y, z, rho, extra, met, qstats,
+                     ef_out) = consensus_fn(
                         self.flat, y, z, rho, extra, jnp.int32(nadmm), mask,
-                        *corr_args,
+                        *ef_args, *corr_args,
                     )
+                    if ef_on:
+                        ef = ef_out
                     dual, primal, mean_rho, survivors = (
                         self._fetch(m) for m in met
                     )
@@ -2171,6 +2316,20 @@ class Trainer:
                 )
         if cfg.strategy == "admm":
             self._rho_store[gid] = rho
+        if ef_on:
+            self._ef_store[gid] = ef
+        if self._adaptive and not (rollback and self._round_poisoned):
+            # the adaptive scheduler's signal: the standalone jitted
+            # group_distances program on the post-round state — the SAME
+            # body the fused path computes in-program. A round the
+            # rollback is about to DISCARD records no drift: its state
+            # never survives, and a finite-but-poisoned distance (a
+            # large-scale corruption the combiner let through) would
+            # permanently inflate the scheduler's skip anchor — the
+            # scheduler keeps its previous estimate, matching the
+            # restored parameters (warn mode keeps the state, so its
+            # drift records stay).
+            self._record_group_distances(nloop, gid)
         if rollback:
             self._maybe_rollback(snap, nloop, gid)
 
@@ -2292,6 +2451,8 @@ class Trainer:
                 )
             )
         quarantine = self._quarantine_enabled()
+        ef_on = self._ef_enabled()
+        ef_args = (self._ef_for(gid),) if ef_on else ()
 
         fold = self._fold_eval_enabled()
         eval_args = (
@@ -2306,11 +2467,12 @@ class Trainer:
             "fused_round", step_num=self._step_num
         ):
             (self.flat, lstate, self.stats, y, z, rho, extra,
-             losses_d, met, param_ok_d, qstats_d, snaps, correct_d) = round_fn(
+             losses_d, met, param_ok_d, qstats_d, snaps, correct_d,
+             ef_d, drift_d) = round_fn(
                 self.flat, lstate, self.stats, self.shard_imgs,
                 self.shard_labels, idx, self.mean, self.std,
-                y, z, rho, extra, masks, *budget_args, *corr_args,
-                *eval_args,
+                y, z, rho, extra, masks, *ef_args, *budget_args,
+                *corr_args, *eval_args,
             )
             if total_delay > 0 and not rollback:
                 # the round is already ENQUEUED (dispatch is
@@ -2412,27 +2574,150 @@ class Trainer:
                 self.recorder.accuracies(acc, nloop=nloop, group=gid, nadmm=a)
         if is_admm:
             self._rho_store[gid] = rho
+        if ef_on:
+            self._ef_store[gid] = ef_d
+        if self._adaptive and not (rollback and self._round_poisoned):
+            # the in-program drift signal (one fetch, replicated) — the
+            # scheduler observes the record at log time; position in the
+            # stream matches the unfused path's post-round record, and a
+            # round the rollback is about to discard records no drift
+            # (see _run_round_unfused — a poisoned distance must not
+            # steer the scheduler or inflate its skip anchor)
+            self.recorder.group_distance(
+                self._fetch(drift_d), nloop=nloop, group=gid
+            )
         if rollback:
             self._maybe_rollback(snap, nloop, gid)
 
+    def _decide_group(self, nloop: int, slot: int) -> Optional[int]:
+        """Which partition group round slot `(nloop, slot)` runs.
+
+        Round-robin returns `group_order[slot]` with zero bookkeeping —
+        the legacy schedule, bit-identical streams. Adaptive asks the
+        scheduler (exchange/schedule.py) ONCE per slot — decided at slot
+        start from the drift signal of COMPLETED rounds, memoized, and
+        streamed as a `group_schedule` record (replayed decisions seed
+        the memo on resume, so crashed+resumed twins run identical
+        slots). Returns None for a SKIPPED slot: the scheduler judged
+        every remaining group drift-quiet, the slot sends nothing, and
+        the record carries the uplink bytes the skipped round's
+        exchanges would have cost (`saved_bytes` — what `report` sums
+        into bytes_saved_by_skipping), priced over the PURE plan's
+        transmitting survivors (`_forgone_round_bytes`) so the saving
+        is never inflated under chaos plans.
+        """
+        if self._scheduler is None:
+            return self.group_order[slot]
+        key = (int(nloop), int(slot))
+        dec = self._schedule_decisions.get(key)
+        if dec is None:
+            visited = {
+                self._schedule_decisions[(int(nloop), s)]["group"]
+                for s in range(slot)
+            }
+            gid, info = self._scheduler.decide(visited)
+            dec = {"slot": int(slot), "group": int(gid), **info}
+            if dec.get("skipped"):
+                dec["saved_bytes"] = self._forgone_round_bytes(nloop, gid)
+            self._schedule_decisions[key] = dec
+            self.recorder.log("group_schedule", dec, nloop=nloop)
+        return None if dec.get("skipped") else int(dec["group"])
+
+    def _loop_visited_gids(self, nloop: int) -> list:
+        """The groups loop `nloop`'s rounds actually RAN, in slot order
+        — `group_order` verbatim for round-robin; the non-skipped slot
+        decisions under the adaptive schedule (pure given the recorded
+        `group_schedule` history, which resume replays). THE one
+        definition for every consumer that must not count skipped
+        rounds: the telemetry reliability counters and the
+        `injected_summary` visits mapping."""
+        if self._scheduler is None:
+            return list(self.group_order)
+        return [
+            d["group"]
+            for (l, s), d in sorted(self._schedule_decisions.items())
+            if l == nloop and not d.get("skipped")
+        ]
+
+    def _forgone_round_bytes(self, nloop: int, gid: int) -> int:
+        """Uplink bytes round `(nloop, gid)` WOULD have shipped — the
+        skipped-slot `saved_bytes` pricing. Pure in (plan seed, cursor,
+        deadline decisions): the same masks-and-budgets arithmetic the
+        resume path uses to reconstruct unstreamed rounds, so the
+        report's `bytes_saved_by_skipping` counts exactly the
+        transmitting clients `comm_bytes` would have (plan dropouts and
+        zero deadline budgets excluded; quarantine only affects the
+        wasted attribution, never the transmit count).
+
+        Deadline budgets come from ALREADY-memoized decisions only —
+        never through `_deadline_for`, whose auto path would TAKE a
+        decision for a round that never runs: a phantom, un-streamed
+        memo entry a resumed twin (which replays `saved_bytes` from the
+        record instead of re-pricing) would not hold, breaking the
+        every-memoized-decision-is-streamed invariant. A skipped slot
+        never decided a deadline, so under the auto policy its pricing
+        simply applies no budget exclusion — identical live and
+        resumed."""
+        cfg = self.cfg
+        if self.injector is not None:
+            masks = self._vslice(
+                self.injector.masks_for_round(nloop, gid, cfg.nadmm), nloop
+            )
+        else:
+            masks = np.ones((cfg.nadmm, cfg.n_clients), np.float32)
+        if self._ragged_enabled():
+            dl = (
+                self._deadline_decisions.get((int(nloop), int(gid)))
+                if cfg.deadline_is_auto
+                else float(cfg.round_deadline)
+            )
+            if dl is not None:
+                if self.injector is not None:
+                    speeds = self._vslice(
+                        self.injector.speeds_for_round(
+                            nloop, gid, cfg.nadmm
+                        ),
+                        nloop,
+                    )
+                    step_t = self.injector.plan.step_time_s
+                else:
+                    speeds = np.ones(
+                        (cfg.nadmm, cfg.n_clients), np.float32
+                    )
+                    step_t = 1.0
+                budgets = step_budgets(
+                    speeds, step_t, self._round_total_steps(), dl
+                )
+                masks = masks * (budgets > 0)
+        return int(
+            sum(self._comm.round_bytes(gid, int(m.sum())) for m in masks)
+        )
+
     def run_loop(self, nloop: int) -> None:
-        """ONE outer loop: cohort gather (cohort mode) → every partition
-        group's round → cohort scatter.
+        """ONE outer loop: cohort gather (cohort mode) → every round
+        slot's partition round → cohort scatter.
 
         The public per-loop entry point — `run()`'s loop body minus the
         commit/checkpoint boundary, and the unit the cohort benchmarks
         time (bench.py `_cohort_probe`,
         benchmarks/client_scaling_tpu.py `_cohort_sweep`): one warm call
-        is exactly one gather→rounds→scatter cycle. The scatter runs
-        BEFORE the caller's stream marker and checkpoint: everything a
-        committed loop claims durable includes the store rows it wrote
-        (an injected crash inside `run_round` skips the scatter, leaving
+        is exactly one gather→rounds→scatter cycle. A loop holds
+        `len(group_order)` round SLOTS; round-robin maps slot s to
+        `group_order[s]` (the legacy schedule, verbatim) while the
+        adaptive scheduler picks each slot's group by drift — or skips
+        the slot outright (`_decide_group`). The scatter runs BEFORE the
+        caller's stream marker and checkpoint: everything a committed
+        loop claims durable includes the store rows it wrote (an
+        injected crash inside `run_round` skips the scatter, leaving
         the store at the previous loop — exactly what that loop's
         checkpoint describes).
         """
         if self._cohort_mode:
             self._begin_loop_cohort(nloop)
-        for gid in self.group_order:
+        for slot in range(len(self.group_order)):
+            gid = self._decide_group(nloop, slot)
+            if gid is None:
+                continue  # skipped slot: nothing trains, nothing ships
             self.run_round(nloop, gid)
         if self._cohort_mode:
             self._end_loop_cohort(nloop)
@@ -2496,11 +2781,21 @@ class Trainer:
         # resume-proof only when a metrics stream replays the pre-crash
         # records; without one the count covers the re-run loops only)
         if self.injector is not None or "quarantine" in self.recorder.series:
+            # adaptive schedule: faults only fire on rounds that RAN —
+            # the per-loop visited-group lists are pure given the
+            # recorded decision history (every slot decided by now, live
+            # or stream-replayed), so the totals stay resume-proof
+            visits = None
+            if self._scheduler is not None:
+                visits = {
+                    l: self._loop_visited_gids(l) for l in range(cfg.nloop)
+                }
             counts = (
                 self.injector.injected_summary(
                     cfg.nloop,
                     self.group_order,
                     cfg.nadmm,
+                    visits=visits,
                     exchanges=cfg.strategy != "none",
                     total_steps=self._round_total_steps(),
                     # deadline rows only where deadline rounds are active
@@ -2625,6 +2920,13 @@ class Trainer:
                 str(g): self._fetch(r) for g, r in self._rho_store.items()
             },
         }
+        if self._ef_store:
+            # error-feedback residuals persist like rho (exchange/,
+            # docs/PERF.md); absent for EF-free runs so their
+            # checkpoints stay byte-compatible with pre-EF builds
+            state["ef_store"] = {
+                str(g): self._fetch(e) for g, e in self._ef_store.items()
+            }
         if self._qkv_layout is not None:
             state["qkv_layout"] = np.int64(self._qkv_layout)
         if self._cohort_mode and self._completed_nloops:
@@ -2709,6 +3011,8 @@ class Trainer:
                 )
         for g, r in state.get("rho_store", {}).items():
             self._rho_store[int(g)] = _owned_copy(self._put(r, csh))
+        for g, e in state.get("ef_store", {}).items():
+            self._ef_store[int(g)] = _owned_copy(self._put(e, csh))
         if self._cohort_mode:
             hist = state.get("cohort_history")
             if hist is not None:
@@ -2739,6 +3043,16 @@ class Trainer:
                         np.full(
                             [int(s) for s in meta["shape"]],
                             self.cfg.admm_rho0,
+                            np.dtype(meta["dtype"]),
+                        ),
+                    )
+                if name.startswith("ef/") and not self.store.has_field(name):
+                    # lazily-registered error-feedback fields restore
+                    # with the zero fill pristine clients gather
+                    self.store.register_field(
+                        name,
+                        np.zeros(
+                            [int(s) for s in meta["shape"]],
                             np.dtype(meta["dtype"]),
                         ),
                     )

@@ -1,38 +1,66 @@
-"""Exchange wire-format codecs: what actually crosses the interconnect.
+"""Exchange subsystem: wire-format codecs + layer-group scheduling —
+what crosses the interconnect, and whether anything crosses at all.
 
 The comm ledger (obs/ledger.py, PR 3) made the paper's bandwidth claim a
-measured number; this package moves the number. TAMUNA
+measured number; this package moves the number, two ways. TAMUNA
 (arXiv:2302.09832) and L-FGADMM (arXiv:1911.03654) both argue that
 compressed / partial exchange is where communication-efficient federated
-optimization actually wins — the codec protocol here is the seed of
-ROADMAP item 3's pluggable-codec interface (top-k sparsification,
-stochastic quantization, sparse masks), shipping with its two simplest
-members: `identity` (f32 on the wire, bit-transparent — the pre-codec
-program compiles unchanged) and `bf16` (half the uplink bytes, one
-round-to-nearest-even per value).
+optimization actually wins:
+
+* **codec zoo** (codec.py): `identity` (f32 on the wire,
+  bit-transparent — the pre-codec program compiles unchanged), `bf16`
+  (half the uplink, one round-to-nearest-even per value), `topk`
+  (TAMUNA-style sparsification: the `ceil(fraction*n)` largest
+  magnitudes as index+value pairs) and `quant` (q8/q4 symmetric
+  stochastic-rounding quantization), each stating its EXACT
+  `bytes_on_wire`, optionally composed with the per-(client, group)
+  error-feedback residual (`--error-feedback`, engine/steps.py);
+* **adaptive layer-group scheduling** (schedule.py,
+  `--group-schedule adaptive`): pick WHICH partition group each round
+  exchanges from the in-scan post-round per-group drift signal —
+  including sending nothing for slots whose best remaining group has
+  stopped drifting (`--group-skip-frac`), the one codec whose wire
+  format is silence.
 
 Placement contract (engine/steps.py `_consensus_local`): the codec wraps
 the UPLINKED partition-group slice only. Master weights, the consensus
 variable z, and all L-BFGS math stay f32; the aggregation — mean, the
 robust order-statistic combiners, AND the z-score auto-quarantine — all
-operate on the DECODED f32 views, so a bf16-encoded liar is still
-quarantined (tests/test_exchange.py). In-transit corruption faults
-(fault/plan.py) garble the decoded view: the adversary sits on the wire,
-after the sender's encoder.
+operate on the DECODED f32 views, so an encoded liar is still
+quarantined whatever the codec (tests/test_exchange.py,
+tests/test_codecs.py). In-transit corruption faults (fault/plan.py)
+garble the decoded view: the adversary sits on the wire, after the
+sender's encoder (and after its error-feedback compensation).
 """
 
 from federated_pytorch_test_tpu.exchange.codec import (
+    EXCHANGE_CODECS,
     EXCHANGE_DTYPES,
     Bf16Codec,
     ExchangeCodec,
     IdentityCodec,
+    QuantCodec,
+    TopKCodec,
     get_codec,
+    make_codec,
+)
+from federated_pytorch_test_tpu.exchange.schedule import (
+    GROUP_SCHEDULES,
+    GroupScheduler,
+    validate_group_skip_frac,
 )
 
 __all__ = [
+    "EXCHANGE_CODECS",
     "EXCHANGE_DTYPES",
+    "GROUP_SCHEDULES",
     "Bf16Codec",
     "ExchangeCodec",
+    "GroupScheduler",
     "IdentityCodec",
+    "QuantCodec",
+    "TopKCodec",
     "get_codec",
+    "make_codec",
+    "validate_group_skip_frac",
 ]

@@ -1,4 +1,4 @@
-"""The codec protocol and its two shipped members (package docstring).
+"""The codec protocol and the codec zoo (package docstring).
 
 A codec is three pure functions plus static wire metadata:
 
@@ -11,31 +11,52 @@ A codec is three pure functions plus static wire metadata:
 
 Codecs must be jit-traceable (encode/decode run INSIDE the fused round
 program) and deterministic — fused and unfused chaos runs must decode
-identical views. `is_identity` is a STATIC build flag: the engine skips
-the roundtrip entirely for the identity codec, so an
-`--exchange-dtype float32` run compiles the exact pre-codec program
-(the bitwise fallback, tests/test_exchange.py).
+identical views, and a crashed+resumed run must re-encode exactly what
+its uninterrupted twin sent (no ambient PRNG state: the quantizer's
+stochastic rounding derives its dither from the value's own bits, see
+`QuantCodec`). `is_identity` is a STATIC build flag: the engine skips
+the roundtrip entirely for the identity codec, so a default run
+compiles the exact pre-codec program (the bitwise fallback,
+tests/test_exchange.py).
 
-Future members (ROADMAP item 3: top-k, stochastic quantization,
-TAMUNA-style sparse masks) implement the same three functions;
-`bytes_on_wire` is per-value-count rather than per-array so sparse
-codecs can report index + payload bytes exactly. NOTE: today's ledger
-consumes the flat `bytes_per_value` (obs/ledger.py `wire_bytes` — exact
-for both dense members here); landing the first sparse codec means
-passing `bytes_on_wire` itself through to the ledger's round arithmetic,
-which is the point at which this protocol method stops being
-forward-looking and becomes the wire contract.
+The zoo (ROADMAP item 2, docs/PERF.md codec table):
+
+* `identity` / `bf16` — the dense members (flat bytes-per-value wire);
+* `topk` (`--exchange-codec topk`) — TAMUNA-style sparse exchange
+  (arXiv:2302.09832): each client ships only its `ceil(fraction * n)`
+  largest-magnitude coordinates as (index, value) pairs;
+* `quant` (`--exchange-codec quant`, `--quant-bits {4,8}`) — symmetric
+  per-client stochastic-rounding quantization: one f32 scale plus
+  `bits` bits per value.
+
+Sparse/framed members cannot state a flat per-value width, so the
+ledger consumes `bytes_on_wire` itself (obs/ledger.py `round_bytes` —
+the point at which the protocol method became the wire contract);
+`flat_wire` marks the dense members whose `bytes_per_value` is still
+the whole story. The optional error-feedback accumulator
+(`--error-feedback`, engine/steps.py) lives OUTSIDE the codec: the
+sender adds its carried residual before encoding and keeps
+`(x + e) - decode(encode(x + e))` for the next exchange, so any lossy
+member composes with it unchanged.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
+from typing import Optional
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 # the `--exchange-dtype` vocabulary (engine/config.py validates against
 # this; the CLI error names the field)
 EXCHANGE_DTYPES = ("float32", "bfloat16")
+
+# the `--exchange-codec` vocabulary (None defers to `--exchange-dtype`,
+# which picks a dense member below)
+EXCHANGE_CODECS = ("topk", "quant")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +66,10 @@ class ExchangeCodec:
     name: str = "identity"
     bytes_per_value: int = 4
     is_identity: bool = True
+    # dense members' uplink is exactly `bytes_per_value * n`; sparse or
+    # framed members (index+value pairs, per-slice scale headers) set
+    # False and the ledger consumes `bytes_on_wire` directly
+    flat_wire: bool = True
 
     def encode(self, x: jnp.ndarray) -> jnp.ndarray:
         return x
@@ -59,6 +84,15 @@ class ExchangeCodec:
     def bytes_on_wire(self, n_values: int) -> int:
         """Exact uplink bytes of one client's `n_values`-value slice."""
         return self.bytes_per_value * int(n_values)
+
+    def describe(self) -> dict:
+        """Static wire identity for the comm summary / report labels
+        (JSON-safe, deterministic key order)."""
+        return {"name": self.name, "label": self.label()}
+
+    def label(self) -> str:
+        """Short human label for frontier points ('topk(0.1)', 'q8')."""
+        return self.name
 
 
 class IdentityCodec(ExchangeCodec):
@@ -90,6 +124,152 @@ class Bf16Codec(ExchangeCodec):
         return wire.astype(jnp.float32)
 
 
+@dataclasses.dataclass(frozen=True)
+class TopKCodec(ExchangeCodec):
+    """Top-k sparsification: ship only the largest-magnitude coordinates.
+
+    The sender keeps its `k = ceil(fraction * n)` largest-|value| entries
+    and transmits them as (index, value) pairs — `bytes_per_value` here
+    is the cost of one KEPT pair (4-byte u32 index + 4-byte f32 value),
+    so `bytes_on_wire(n) = k(n) * 8`, exact whatever the data. The
+    on-device wire array models the RECEIVER's view of that packed
+    format: the dense scatter of the pairs, zeros elsewhere (`decode` is
+    then the identity) — every downstream consumer (mean, robust
+    combiners, quarantine norms) sees exactly what decoding the packed
+    pairs would produce.
+
+    Selection is per client slice (last axis), by magnitude with
+    NON-FINITE values ranked above everything: a nan_burst liar's NaNs
+    are always among the kept pairs, so the corruption stays visible to
+    the combiners' exclusion logic and the quarantine's finiteness flag
+    (a sparsifier that silently dropped the evidence would launder the
+    attack). Ties at the k-th magnitude resolve to the lower index
+    (lax.top_k's stable order) — deterministic, so fused, unfused, and
+    resumed runs keep identical wires.
+    """
+
+    name: str = "topk"
+    bytes_per_value: int = 8  # one kept (u32 index, f32 value) pair
+    is_identity: bool = False
+    flat_wire: bool = False
+    fraction: float = 0.1
+
+    def __post_init__(self):
+        f = self.fraction
+        if isinstance(f, bool) or not isinstance(f, (int, float)):
+            raise ValueError(
+                f"topk_fraction must be a number in (0, 1], got {f!r}"
+            )
+        if not (0.0 < float(f) <= 1.0):
+            raise ValueError(
+                f"topk_fraction must be in (0, 1], got {f}"
+            )
+
+    def kept(self, n_values: int) -> int:
+        """How many coordinates of an `n_values` slice go on the wire."""
+        n = int(n_values)
+        return min(n, max(1, math.ceil(self.fraction * n))) if n else 0
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        n = x.shape[-1]
+        k = self.kept(n)
+        if k >= n:
+            return x
+
+        def one(row):
+            # non-finite magnitudes rank as +inf: corruption is always
+            # selected onto the wire, never silently dropped
+            mag = jnp.where(jnp.isfinite(row), jnp.abs(row), jnp.inf)
+            _, idx = lax.top_k(mag, k)
+            keep = jnp.zeros((n,), bool).at[idx].set(True)
+            return jnp.where(keep, row, 0.0)
+
+        flat = x.reshape((-1, n))
+        return jax.vmap(one)(flat).reshape(x.shape)
+
+    def bytes_on_wire(self, n_values: int) -> int:
+        return self.kept(n_values) * self.bytes_per_value
+
+    def describe(self) -> dict:
+        return {**super().describe(), "fraction": float(self.fraction)}
+
+    def label(self) -> str:
+        return f"topk({self.fraction:g})"
+
+
+def _bit_hash_uniform(x: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic per-value dither in [0, 1): a murmur3-style finalizer
+    over the value's OWN f32 bit pattern. No PRNG key, no ambient state —
+    pure in the input, so fused/unfused/crash-resumed runs quantize
+    identically (the codec determinism contract, module docstring). The
+    dither varies per coordinate and changes whenever the value does,
+    which is what stochastic rounding needs from round to round.
+    """
+    h = lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    # top 24 bits -> [0, 1): exactly representable in f32
+    return (h >> 8).astype(jnp.float32) * jnp.float32(2.0**-24)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantCodec(ExchangeCodec):
+    """Symmetric stochastic-rounding quantization (q8 / q4).
+
+    Per client slice (last axis): one f32 scale `s = max|finite x| / Q`
+    with `Q = 2^(bits-1) - 1`, then each value rounds stochastically to
+    an integer level in `[-Q, Q]` — `floor(x/s + u)` with the
+    deterministic per-value dither `u` of `_bit_hash_uniform`, clipped.
+    The wire is the scale header (4 bytes) plus `bits` bits per value:
+    `bytes_on_wire(n) = 4 + ceil(n * bits / 8)`, exact. As with topk the
+    on-device wire array models the receiver's decoded view
+    (`level * s`, `decode` the identity).
+
+    NON-FINITE values bypass quantization and cross as themselves (a
+    real wire would use a reserved level; either way the receiver sees
+    the non-finite evidence), so nan_burst liars stay visible. Error
+    bound: `|roundtrip(x) - x| < s` for every finite value — one
+    quantization step (tests/test_codecs.py pins it).
+    """
+
+    name: str = "quant"
+    bytes_per_value: int = 1  # informational; the wire is bit-packed
+    is_identity: bool = False
+    flat_wire: bool = False
+    bits: int = 8
+
+    def __post_init__(self):
+        if isinstance(self.bits, bool) or self.bits not in (4, 8):
+            raise ValueError(
+                f"quant_bits must be 4 or 8, got {self.bits!r}"
+            )
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        q = float(2 ** (self.bits - 1) - 1)
+        finite = jnp.isfinite(x)
+        amax = jnp.max(
+            jnp.where(finite, jnp.abs(x), 0.0), axis=-1, keepdims=True
+        )
+        scale = jnp.where(amax > 0, amax / q, 1.0)
+        level = jnp.clip(
+            jnp.floor(x / scale + _bit_hash_uniform(x)), -q, q
+        )
+        return jnp.where(finite, level * scale, x)
+
+    def bytes_on_wire(self, n_values: int) -> int:
+        n = int(n_values)
+        return (4 + math.ceil(n * self.bits / 8)) if n else 0
+
+    def describe(self) -> dict:
+        return {**super().describe(), "bits": int(self.bits)}
+
+    def label(self) -> str:
+        return f"q{self.bits}"
+
+
 _CODECS = {
     "float32": IdentityCodec(),
     "bfloat16": Bf16Codec(),
@@ -105,3 +285,26 @@ def get_codec(exchange_dtype: str) -> ExchangeCodec:
             f"exchange_dtype must be one of {list(EXCHANGE_DTYPES)}, "
             f"got {exchange_dtype!r}"
         ) from None
+
+
+def make_codec(
+    exchange_dtype: str = "float32",
+    exchange_codec: Optional[str] = None,
+    topk_fraction: float = 0.1,
+    quant_bits: int = 8,
+) -> ExchangeCodec:
+    """The ONE config-to-codec mapping (engine/steps.py builds the
+    consensus body through it, engine/trainer.py prices the ledger
+    through it — a drifted copy would let the program ship different
+    bytes than the ledger records). `exchange_codec=None` defers to
+    `exchange_dtype` (the dense members: identity / bf16)."""
+    if exchange_codec is None:
+        return get_codec(exchange_dtype)
+    if exchange_codec == "topk":
+        return TopKCodec(fraction=topk_fraction)
+    if exchange_codec == "quant":
+        return QuantCodec(bits=quant_bits)
+    raise ValueError(
+        f"exchange_codec must be one of {list(EXCHANGE_CODECS)} "
+        f"(or None for the --exchange-dtype member), got {exchange_codec!r}"
+    )

@@ -1,0 +1,165 @@
+"""Adaptive layer-group scheduling: spend the round on the group that
+drifted.
+
+Round-robin visits every partition group once per outer loop in a fixed
+order — the reference's schedule, and the right one when nothing is
+known about the groups. But the groups are NOT equally out of consensus:
+L-FGADMM (arXiv:1911.03654) shows layer-wise exchange frequency should
+follow how much a layer's copies disagree, and the repo already computes
+exactly that disagreement — `parallel/diagnostics.py group_distances`,
+each group's mean client distance from the cross-client mean. This
+module turns that signal into the schedule: `--group-schedule adaptive`
+picks, at each round slot, the not-yet-visited group with the LARGEST
+last-observed drift, and (with `--group-skip-frac`) sends NOTHING at all
+for tail slots whose best remaining group has drifted to a negligible
+fraction of the run's peak — the first codec that saves bytes by
+staying silent.
+
+Mechanics mirror the PR-11 `DeadlineController` exactly:
+
+* the signal is streamed: under the adaptive schedule every round ends
+  with a `group_distance` record (in-scan inside the fused round
+  program — engine/steps.py `build_round_fn(group_drift=True)` shares
+  the `group_distances` body, so the folded dispatch stays
+  `{round: 1, round_init: 1}`; the unfused path dispatches the same
+  body standalone), replacing the `--diagnostics-every` host cadence as
+  the signal source;
+* the scheduler is a pure OBSERVER of those records (recorder-observer
+  protocol, utils/metrics.py) — decisions are a pure function of the
+  streamed record sequence, taken ONCE at round start, memoized by the
+  trainer and streamed as the `group_schedule` series;
+* resume REPLAYS: a resumed run feeds the kept records through
+  `replay()` and seeds its decision memo from the replayed
+  `group_schedule` records, so a crashed+resumed twin's stream is
+  byte-identical to an uninterrupted run's (the trainer refuses to
+  resume an adaptive run without a metrics stream, like auto
+  deadlines).
+
+Signal shape notes: under full-participation FedAvg the broadcast sets
+every survivor's active-group coordinates to z, so an exchanged group's
+post-round drift is ~0 and an untouched group's stays wherever training
+left it — the argmax then behaves like least-recently-exchanged, which
+degrades gracefully to round-robin order on all-equal drift (ties break
+toward the round-robin position). The signal is sharpest where copies
+genuinely diverge: ADMM (clients keep their own x), partial
+participation (dropouts/deadline misses rejoin stale), and cohort mode
+(gathered clients trained in different loops).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Tuple
+
+# the `--group-schedule` vocabulary (engine/config.py validates against
+# this; the CLI error names the field)
+GROUP_SCHEDULES = ("roundrobin", "adaptive")
+
+
+def validate_group_skip_frac(skip_frac) -> float:
+    """THE one range definition for `--group-skip-frac`, shared by the
+    config validation (engine/config.py) and `GroupScheduler` — the
+    make_codec delegation pattern: config-time and run-time validation
+    cannot drift apart when there is only one check."""
+    if isinstance(skip_frac, bool) or not isinstance(
+        skip_frac, (int, float)
+    ):
+        raise ValueError(
+            f"group_skip_frac must be a number in [0, 1), got {skip_frac!r}"
+        )
+    if not 0.0 <= float(skip_frac) < 1.0:
+        raise ValueError(
+            f"group_skip_frac must be in [0, 1), got {skip_frac}"
+        )
+    return float(skip_frac)
+
+
+class GroupScheduler:
+    """Per-slot group decisions from the observed drift signal.
+
+    One instance per run, observing the recorder's streamed
+    `group_distance` records (each a `[num_groups]` vector — one round's
+    post-round per-group distances). `decide(visited)` returns
+    `(gid, info)` for the next slot: the highest-drift group among
+    `group_order` minus `visited`, round-robin warmup while any remaining
+    group is unobserved, and `info["skipped"] = True` when the skip rule
+    fires (`drift <= skip_frac * peak observed drift` — everything still
+    unvisited has drifted to noise, so the slot sends nothing). Within a
+    loop the trainer marks skipped groups visited too: once the BEST
+    remaining group is below the skip line, so is everything after it.
+    The FIRST slot of a loop (`visited` empty) never skips: every loop
+    trains at least its top-drift group, so the drift signal refreshes
+    and an all-quiet state cannot become absorbing (skipped slots run
+    no training — if they could skip a whole loop, nothing would ever
+    move the signal back above the line).
+
+    Purity contract: state is a pure function of the observed record
+    sequence (non-finite entries are ignored — a rolled-back poisoned
+    round must not wedge the argmax on NaN), so `replay()` of a resumed
+    stream reproduces the live scheduler's decisions exactly.
+    """
+
+    def __init__(self, group_order: Iterable[int], skip_frac: float = 0.0):
+        self.group_order: List[int] = [int(g) for g in group_order]
+        if not self.group_order:
+            raise ValueError("group_order must name at least one group")
+        self.skip_frac = validate_group_skip_frac(skip_frac)
+        self._drift: Dict[int, float] = {}  # gid -> latest finite drift
+        self._peak = 0.0  # largest drift ever observed (the skip anchor)
+
+    # ---------------------------------------- recorder-observer protocol
+
+    def observe(self, name: str, rec: dict) -> None:
+        if name != "group_distance":
+            return
+        vals = rec.get("value")
+        if not isinstance(vals, (list, tuple)):
+            return
+        for g in self.group_order:
+            if g < len(vals):
+                v = float(vals[g])
+                if math.isfinite(v):
+                    self._drift[g] = v
+                    if v > self._peak:
+                        self._peak = v
+
+    def replay(self, records: Iterable[Tuple[str, dict]]) -> None:
+        """Rebuild signal state from a resumed stream's replayed records
+        (stream order — the same sequence `observe` saw live)."""
+        for name, rec in records:
+            self.observe(name, rec)
+
+    # ----------------------------------------------------------- policy
+
+    def decide(self, visited) -> Tuple[int, dict]:
+        """The next slot's group + its provenance dict (the
+        `group_schedule` record value minus slot/group): `source` is
+        'warmup' while the pick has no drift evidence, else 'drift' with
+        the deciding value; `skipped` appears (True) when the slot
+        should send nothing. Deterministic: ties break toward the
+        earlier round-robin position."""
+        remaining = [g for g in self.group_order if g not in visited]
+        if not remaining:
+            raise ValueError(
+                f"every group of {self.group_order} already visited"
+            )
+        unobserved = [g for g in remaining if g not in self._drift]
+        if unobserved:
+            return unobserved[0], {"source": "warmup"}
+        best = max(
+            range(len(remaining)),
+            key=lambda i: (self._drift[remaining[i]], -i),
+        )
+        gid = remaining[best]
+        d = self._drift[gid]
+        info = {"source": "drift", "drift": round(d, 9)}
+        # skip only TAIL slots (`visited` nonempty): a loop's first slot
+        # always runs, so every loop trains at least one group and emits
+        # a fresh drift record. Without this floor an all-quiet state
+        # would be absorbing — skipped slots run no training, the signal
+        # would freeze below the line, and the rest of the run would
+        # silently no-op while the report counted the "savings".
+        if self.skip_frac > 0.0 and self._peak > 0.0 and visited:
+            if d <= self.skip_frac * self._peak:
+                info["skipped"] = True
+        return gid, info

@@ -218,6 +218,7 @@ class FaultInjector:
         total_steps: int | None = None,
         deadline_s: "float | dict | None" = None,
         cohort=None,
+        visits: "dict | None" = None,
     ) -> dict:
         """Fault counts over the experiment's full round schedule.
 
@@ -253,6 +254,14 @@ class FaultInjector:
         loop's SAMPLED clients count (an unsampled client's scheduled
         dropout was never injected into any exchange). The sampler's
         purity keeps the totals resume-proof exactly like the plan's.
+
+        Adaptive group schedules (exchange/schedule.py): `visits` is the
+        `{nloop: [visited gids]}` mapping of rounds that actually RAN —
+        a fault scheduled at a group the scheduler never picked (or
+        skipped) was never injected. Pure given the recorded
+        `group_schedule` decision history, which the stream replay
+        restores on resume — same purity story as `deadline_s` dicts.
+        None keeps the fixed `group_order` schedule.
         """
         drops = stragglers = crashes = corruptions = 0
         deadline_misses = capped_stalls = churned = 0
@@ -261,7 +270,10 @@ class FaultInjector:
             if self.plan.has_churn:
                 avail = self.plan.availability(self.n_clients, nloop)
                 churned += int(avail.size - avail.sum())
-            for gid in group_order:
+            loop_gids = (
+                visits.get(nloop, []) if visits is not None else group_order
+            )
+            for gid in loop_gids:
                 dl = (
                     deadline_s.get((nloop, gid))
                     if isinstance(deadline_s, dict)

@@ -52,17 +52,32 @@ class CommLedger:
         data_floor_bytes: Optional[int] = None,
         wire_bytes: Optional[int] = None,
         exchange_dtype: str = "float32",
+        codec=None,
     ):
         """`dtype_bytes` is the PARAMETER dtype's width (what the naive
-        full-model f32 exchange baseline ships); `wire_bytes` is what one
-        exchanged value actually costs on the wire under the exchange
-        codec (exchange/ — half of dtype_bytes under bf16). Defaults to
-        dtype_bytes: pre-codec ledgers are unchanged."""
+        full-model f32 exchange baseline ships). The wire side is priced
+        one of two ways: `codec` (an exchange/ `ExchangeCodec`) makes
+        every exchange cost `codec.bytes_on_wire(group_size)` per
+        transmitting client — EXACT for sparse/framed members (topk's
+        index+value pairs, quant's scale header) where no flat per-value
+        width exists; without a codec, `wire_bytes` is the flat
+        bytes-per-value (half of dtype_bytes under bf16; defaults to
+        dtype_bytes — pre-codec ledgers are unchanged)."""
         self.partition = partition
         self.n_clients = int(n_clients)
         self.dtype_bytes = int(dtype_bytes)
+        self.codec = codec
+        if codec is not None and wire_bytes is None and codec.flat_wire:
+            wire_bytes = codec.bytes_per_value
         self.wire_bytes = (
             int(wire_bytes) if wire_bytes is not None else int(dtype_bytes)
+        )
+        # the flat per-value width the summary reports; None for codecs
+        # whose wire has no such number (topk, quant)
+        self.wire_bytes_per_value: Optional[int] = (
+            None
+            if codec is not None and not codec.flat_wire
+            else self.wire_bytes
         )
         self.exchange_dtype = str(exchange_dtype)
         self.data_floor_bytes = (
@@ -79,10 +94,19 @@ class CommLedger:
     # --------------------------------------------------------- pure queries
 
     def round_bytes(self, gid: int, survivors: int) -> int:
-        """Uplink bytes of ONE consensus exchange of group `gid` — at the
-        WIRE width: the codec's bytes-per-value, exactly half the f32
-        ledger under the bf16 codec (tests/test_exchange.py hand-check)."""
-        return self.partition.group_size(gid) * self.wire_bytes * int(survivors)
+        """Uplink bytes of ONE consensus exchange of group `gid` — at
+        the WIRE cost: the codec's exact `bytes_on_wire` of the group
+        slice per transmitting client (half the f32 ledger under bf16,
+        `kept * 8` under topk, `4 + ceil(n*bits/8)` under quant —
+        tests/test_exchange.py, tests/test_codecs.py hand-checks), or
+        the flat `wire_bytes` per value for codec-less ledgers."""
+        if self.codec is not None:
+            per_client = self.codec.bytes_on_wire(
+                self.partition.group_size(gid)
+            )
+        else:
+            per_client = self.partition.group_size(gid) * self.wire_bytes
+        return per_client * int(survivors)
 
     def full_round_bytes(self, survivors: int) -> int:
         """The same exchange if the WHOLE parameter vector were sent —
@@ -98,10 +122,18 @@ class CommLedger:
         per-group wire-format one, over one outer loop's visit order —
         the codec's compression factor multiplies the partition's.
         """
-        part = sum(self.partition.group_size(g) for g in group_order)
+        if self.codec is not None:
+            part_wire = sum(
+                self.codec.bytes_on_wire(self.partition.group_size(g))
+                for g in group_order
+            )
+        else:
+            part_wire = self.wire_bytes * sum(
+                self.partition.group_size(g) for g in group_order
+            )
         return (
             self.partition.total * len(group_order) * self.dtype_bytes
-        ) / (part * self.wire_bytes)
+        ) / part_wire
 
     # ---------------------------------------------------------- accumulation
 
@@ -162,14 +194,17 @@ class CommLedger:
     def summary(self) -> dict:
         """End-of-run totals vs the two baselines (module docstring)."""
         up, full = self._uplink, self._full
-        return {
+        out = {
             "rounds": self._rounds,
             "n_clients": self.n_clients,
             "dtype_bytes": self.dtype_bytes,
             # the wire format (exchange/): what one exchanged value
-            # actually cost on the uplink under the active codec
+            # actually cost on the uplink under the active codec (None
+            # for sparse/framed codecs — their exact per-exchange cost
+            # lives in the codec descriptor below and the comm_bytes
+            # records themselves)
             "exchange_dtype": self.exchange_dtype,
-            "wire_bytes_per_value": self.wire_bytes,
+            "wire_bytes_per_value": self.wire_bytes_per_value,
             "bytes_total": int(up),
             "bytes_total_bidirectional": int(2 * up),
             "bytes_per_round_mean": (
@@ -187,3 +222,9 @@ class CommLedger:
                 else None
             ),
         }
+        if self.codec is not None:
+            # the full wire identity (name + parameters + short label —
+            # exchange/codec.py describe()): what `report` labels
+            # frontier points with (obs/registry.py)
+            out["codec"] = self.codec.describe()
+        return out

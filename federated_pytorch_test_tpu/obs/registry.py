@@ -159,10 +159,23 @@ class RunRegistry:
         exchanges = 0
         deadlines: List[float] = []
         deadline_sources: Dict[str, int] = {}
+        # adaptive layer-group scheduling evidence (exchange/schedule.py
+        # `group_schedule` records): presence marks the run adaptive,
+        # skipped slots sum into bytes_saved_by_skipping — uplink the
+        # scheduler saved by sending NOTHING for drift-quiet slots
+        schedule = None
+        skipped_rounds = 0
+        bytes_saved = 0
         for series, rec in run.records:
             if series == "comm_bytes":
                 cum_bytes += int(rec["value"])
                 exchanges += 1
+            elif series == "group_schedule":
+                schedule = "adaptive"
+                v = rec.get("value")
+                if isinstance(v, dict) and v.get("skipped"):
+                    skipped_rounds += 1
+                    bytes_saved += int(v.get("saved_bytes", 0))
             elif series == "client_time":
                 # each exchange's SIMULATED round wall (the coordinator
                 # closes the round at min(slowest client, deadline) —
@@ -199,16 +212,39 @@ class RunRegistry:
                     health_anomalies += len(v.get("anomalies", ()))
                     health_last = v
         final_acc = curve[-1]["accuracy"] if curve else None
+        # the wire identity the frontier labels points with: the codec
+        # descriptor the comm summary carries (exchange/codec.py
+        # describe()), falling back to the dense dtype name for streams
+        # from codec-less ledgers, plus the schedule policy
+        codec_label = None
+        if comm_summary is not None:
+            cd = comm_summary.get("codec")
+            if isinstance(cd, dict) and cd.get("label"):
+                codec_label = str(cd["label"])
+            elif comm_summary.get("exchange_dtype") == "bfloat16":
+                codec_label = "bf16"
+            elif comm_summary.get("exchange_dtype"):
+                codec_label = "identity"
+        config_label = (
+            f"{codec_label or '?'}/{schedule or 'roundrobin'}"
+        )
         summary: dict = {
             "experiment": run.label,
             "stream": {
                 "records": len(run.records),
                 "markers": len(run.markers),
             },
+            "config": {
+                "codec": codec_label,
+                "schedule": schedule or "roundrobin",
+                "label": config_label,
+            },
             "exchanges": exchanges,
             "evals": len(curve),
             "final_accuracy": final_acc,
             "total_comm_bytes": cum_bytes,
+            "skipped_rounds": skipped_rounds,
+            "bytes_saved_by_skipping": bytes_saved,
             "sim_round_wall_total_s": round(cum_sim_wall, 6),
             "curve": curve,
         }
@@ -295,6 +331,14 @@ class RunRegistry:
             ],
             "total_comm_bytes",
         )
+        for p in frontier:
+            # label every point with its codec+scheduler config (not
+            # just preset:seed) and the uplink the scheduler saved by
+            # sending nothing — both content-derived, so twin
+            # directories stay byte-identical
+            s = runs[p["run"]]
+            p["config"] = s["config"]["label"]
+            p["bytes_saved_by_skipping"] = s["bytes_saved_by_skipping"]
         doc = {
             "report_version": REPORT_VERSION,
             "runs": runs,
@@ -330,38 +374,47 @@ def render_markdown(doc: dict) -> str:
     """The report document as a compact markdown digest."""
     lines = ["# Experiment report", "", "## Runs", ""]
     lines.append(
-        "| run | experiment | evals | final acc | comm bytes | "
+        "| run | experiment | config | evals | final acc | comm bytes | "
         "exchanges | health anomalies |"
     )
-    lines.append("|---|---|---|---|---|---|---|")
+    lines.append("|---|---|---|---|---|---|---|---|")
     for name, s in doc["runs"].items():
         acc = (
             f"{s['final_accuracy']:.4f}"
             if s["final_accuracy"] is not None
             else "-"
         )
+        cfg_label = s.get("config", {}).get("label", "-")
         lines.append(
-            f"| {name} | {s['experiment']} | {s['evals']} | {acc} "
-            f"| {s['total_comm_bytes']:,} | {s['exchanges']} "
+            f"| {name} | {s['experiment']} | {cfg_label} | {s['evals']} "
+            f"| {acc} | {s['total_comm_bytes']:,} | {s['exchanges']} "
             f"| {s['health']['anomalies']} |"
         )
     lines += ["", "## Convergence vs bytes frontier", ""]
-    lines.append("| run | total comm bytes | final acc | pareto |")
-    lines.append("|---|---|---|---|")
+    lines.append(
+        "| run | config | total comm bytes | bytes saved by skipping "
+        "| final acc | pareto |"
+    )
+    lines.append("|---|---|---|---|---|---|")
     for p in doc["frontier"]:
         acc = (
             f"{p['final_accuracy']:.4f}"
             if p["final_accuracy"] is not None
             else "-"
         )
-        star = "*" if p["pareto"] else ""
+        flag = "*" if p["pareto"] else "dominated"
         lines.append(
-            f"| {p['run']} | {p['total_comm_bytes']:,} | {acc} | {star} |"
+            f"| {p['run']} | {p.get('config', '-')} "
+            f"| {p['total_comm_bytes']:,} "
+            f"| {p.get('bytes_saved_by_skipping', 0):,} | {acc} | {flag} |"
         )
     lines.append("")
     lines.append(
         "`*` = on the frontier: no other run reached at least this "
-        "accuracy with at most these bytes."
+        "accuracy with at most these bytes; every other point is "
+        "explicitly `dominated`. `bytes saved by skipping` sums the "
+        "uplink the adaptive scheduler declined to spend (skipped "
+        "slots' `group_schedule` records)."
     )
     if doc.get("deadline_frontier"):
         lines += ["", "## Convergence vs deadline frontier", ""]

@@ -47,7 +47,15 @@
 #                                    combiner + corruption + quarantine
 #                                    + planned crash recovered via
 #                                    rerun, halved comm ledger asserted
-#                                    on the stream) and
+#                                    on the stream), codec_smoke (the
+#                                    codec-zoo frontier probe: identity/
+#                                    topk(0.1)+EF+adaptive/q8 sweep over
+#                                    one corruption+dropout plan, topk
+#                                    crashed + resumed with twin stream
+#                                    identity incl. group_schedule
+#                                    records, `report` gating the
+#                                    <=25%-bytes / within-2-points
+#                                    frontier acceptance) and
 #                                    cohort_smoke (10k virtual clients,
 #                                    C=8 cohorts, dropout+corruption
 #                                    keyed by virtual id, trimmed
@@ -461,6 +469,130 @@ assert len(cohorts) == 3 and all(
   rm -rf "$d"
 }
 
+codec_smoke() {
+  # End-to-end codec zoo + adaptive layer-group scheduling through the
+  # REAL CLI (exchange/, docs/PERF.md §Codec zoo): a 3-codec sweep —
+  # identity/roundrobin baseline, topk(0.1)+error-feedback under the
+  # ADAPTIVE scheduler, and q8 — over the identical corruption+dropout
+  # plan with the trimmed combiner. The topk run is CRASHED by a
+  # planned crash at (nloop=1, gid=2, nadmm=0) and recovered by
+  # rerunning the identical command (--resume auto replays the slot
+  # decisions and drift signal from the stream); an uninterrupted twin
+  # proves crashed+resumed stream identity — group_schedule and
+  # group_distance records included. `report` over the sweep then
+  # gates the ISSUE-13 frontier acceptance: the sparse point lands
+  # within 2 accuracy points of the f32/roundrobin baseline at <= 25%
+  # of its cumulative uplink bytes (topk(0.1) prices at 20%: 8 bytes
+  # per kept pair on a tenth of the coordinates vs 4 bytes/value
+  # dense), with the report byte-identical between the crashed+resumed
+  # sweep dir and the twin dir.
+  local d; d="$(mktemp -d)"
+  mkdir -p "$d/a" "$d/b"
+  local plan="seed=5,dropout=0.2,corrupt=1:scale:10"
+  local base=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 240 --synthetic-n-test 60 --batch 40
+    --nloop 2 --nadmm 2 --max-groups 2 --eval-batch 30
+    --robust-agg trimmed --robust-f 1
+    --fault-mode rollback --save-model --resume auto)
+  echo "codec smoke: f32/roundrobin baseline..."
+  "${base[@]}" --fault-plan "$plan" \
+    --checkpoint-dir "$d/ckpt_f32" --metrics-stream "$d/a/f32.jsonl" \
+    > "$d/f32.log" 2>&1 || {
+    echo "codec smoke FAILED: f32 baseline did not finish" >&2
+    tail -20 "$d/f32.log" >&2; rm -rf "$d"; return 1
+  }
+  cp "$d/a/f32.jsonl" "$d/b/f32.jsonl"
+  local topk=("${base[@]}" --exchange-codec topk --topk-fraction 0.1
+    --error-feedback --group-schedule adaptive)
+  local crash=("${topk[@]}" --fault-plan "$plan,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt_topk" --metrics-stream "$d/a/topk.jsonl")
+  echo "codec smoke: expecting the planned topk crash..."
+  if "${crash[@]}" > "$d/topk1.log" 2>&1; then
+    echo "codec smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/topk1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "codec smoke: resuming..."
+  "${crash[@]}" > "$d/topk2.log" 2>&1 || {
+    echo "codec smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/topk2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${topk[@]}" --fault-plan "$plan" \
+    --checkpoint-dir "$d/ckpt_topk_twin" --metrics-stream "$d/b/topk.jsonl" \
+    > "$d/twin.log" 2>&1 || {
+    echo "codec smoke FAILED: the uninterrupted twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  echo "codec smoke: q8 run..."
+  "${base[@]}" --exchange-codec quant --quant-bits 8 --fault-plan "$plan" \
+    --checkpoint-dir "$d/ckpt_q8" --metrics-stream "$d/a/q8.jsonl" \
+    > "$d/q8.log" 2>&1 || {
+    echo "codec smoke FAILED: q8 run did not finish" >&2
+    tail -20 "$d/q8.log" >&2; rm -rf "$d"; return 1
+  }
+  cp "$d/a/q8.jsonl" "$d/b/q8.jsonl"
+  if grep -q 'round_rollback' "$d/a/topk.jsonl" "$d/a/q8.jsonl"; then
+    echo "codec smoke FAILED: a codec broke the robust combiner (rollback)" >&2
+    rm -rf "$d"; return 1
+  fi
+  assert_stream_identity "$d/a/topk.jsonl" "$d/b/topk.jsonl" '
+sched = [d for d in recs if d.get("series") == "group_schedule"]
+assert sched and all(
+    d["value"]["source"] in ("warmup", "drift") for d in sched)
+assert any(d.get("series") == "group_distance" for d in recs)
+summ = [d for d in recs if d.get("series") == "comm_summary"][-1]["value"]
+assert summ["codec"]["label"] == "topk(0.1)", summ
+' || {
+    echo "codec smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  python -m federated_pytorch_test_tpu report "$d/a" \
+    --json "$d/a.json" --md "$d/a.md" --quiet || {
+    echo "codec smoke FAILED: report over the sweep dir errored" >&2
+    rm -rf "$d"; return 1
+  }
+  python -m federated_pytorch_test_tpu report "$d/b" \
+    --json "$d/b.json" --md "$d/b.md" --quiet || {
+    echo "codec smoke FAILED: report over the twin dir errored" >&2
+    rm -rf "$d"; return 1
+  }
+  cmp -s "$d/a.json" "$d/b.json" && cmp -s "$d/a.md" "$d/b.md" || {
+    echo "codec smoke FAILED: crashed+resumed report differs from twin" >&2
+    diff "$d/a.json" "$d/b.json" | head -20 >&2; rm -rf "$d"; return 1
+  }
+  python - "$d/a.json" <<'PY' || { rm -rf "$d"; return 1; }
+import json, sys
+
+doc = json.load(open(sys.argv[1]))
+runs = doc["runs"]
+assert set(runs) == {"f32", "topk", "q8"}, sorted(runs)
+f32, topk, q8 = runs["f32"], runs["topk"], runs["q8"]
+assert f32["config"]["label"] == "identity/roundrobin", f32["config"]
+assert topk["config"]["label"] == "topk(0.1)/adaptive", topk["config"]
+assert q8["config"]["label"] == "q8/roundrobin", q8["config"]
+# THE frontier acceptance (ISSUE 13): the sparse+scheduled point lands
+# within 2 accuracy points of the f32/roundrobin baseline at <= 25% of
+# its cumulative uplink bytes (bf16's halving was 50%)
+assert topk["total_comm_bytes"] <= 0.25 * f32["total_comm_bytes"], (
+    topk["total_comm_bytes"], f32["total_comm_bytes"])
+assert topk["final_accuracy"] >= f32["final_accuracy"] - 0.02, (
+    topk["final_accuracy"], f32["final_accuracy"])
+# q8 prices at ~25.1% (scale header over 1 byte/value) — cheaper than
+# bf16's 50% but above the 25% gate; the frontier shows both points
+assert q8["total_comm_bytes"] < 0.27 * f32["total_comm_bytes"]
+# the cheapest codec is on the frontier, the baseline is dominated or
+# the single most-accurate point; every frontier row carries its
+# codec+scheduler label
+front = {p["run"]: p for p in doc["frontier"]}
+assert front["topk"]["pareto"], doc["frontier"]
+assert front["topk"]["config"] == "topk(0.1)/adaptive"
+print("codec smoke: frontier acceptance OK",
+      {k: (v["total_comm_bytes"], v["final_accuracy"])
+       for k, v in runs.items()})
+PY
+  echo "codec smoke OK"
+  rm -rf "$d"
+}
+
 report_smoke() {
   # End-to-end cross-run registry through the REAL CLI (obs/registry.py,
   # docs/OBSERVABILITY.md): a two-point codec sweep — identical configs
@@ -557,6 +689,7 @@ case "$tier" in
     chaos_smoke
     hetero_smoke
     bf16_smoke
+    codec_smoke
     cohort_smoke
     fleet_smoke
     report_smoke
@@ -567,6 +700,7 @@ case "$tier" in
     chaos_smoke
     hetero_smoke
     bf16_smoke
+    codec_smoke
     cohort_smoke
     fleet_smoke
     report_smoke

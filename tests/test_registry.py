@@ -162,9 +162,20 @@ def test_report_frontier_hand_checked_against_known_totals(tmp_path):
     # aligned-by-eval series for cross-run plots
     assert doc["aligned"]["accuracy_by_eval"]["costly"] == [0.4, 0.6, 0.7]
 
+    # codec-less streams label as the dense identity/roundrobin config
+    assert cheap["config"]["label"] == "identity/roundrobin"
+    assert cheap["bytes_saved_by_skipping"] == 0
+
     md = render_markdown(doc)
-    assert "| cheap | fedavg:seed0 | 3 | 0.8000 | 300 | 3 | 0 |" in md
-    assert "| costly | 600 | 0.7000 |  |" in md
+    assert (
+        "| cheap | fedavg:seed0 | identity/roundrobin | 3 | 0.8000 "
+        "| 300 | 3 | 0 |" in md
+    )
+    # dominated points are flagged explicitly in the frontier table
+    assert (
+        "| costly | identity/roundrobin | 600 | 0 | 0.7000 | dominated |"
+        in md
+    )
 
 
 @smoke
