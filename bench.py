@@ -663,6 +663,68 @@ def _health_probe():
     }
 
 
+def _flight_probe():
+    """Warm-round wall with the flight recorder on vs off, plus the
+    bench process's peak host RSS.
+
+    The flight recorder (obs/flight.py) is a second sink on the metric
+    stream: per streamed record one list append, per round one deque
+    rotation — no device work, no extra I/O until an incident dumps —
+    so its per-round cost must be ≈ 0 (the ISSUE-14 gate, the health
+    probe's discipline: both trainers stream to a JSONL file, only the
+    recorder flag differs, and a shared-host delta within scheduler
+    noise can read slightly negative — that IS the ≈ 0 verdict).
+    `memory_rss_peak_mb` rides along from obs/memory.py — the
+    bounded-RSS evidence ROADMAP item 4's spilled-store gate will
+    consume.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+    from federated_pytorch_test_tpu.obs import host_rss_peak_bytes
+
+    src = synthetic_cifar(n_train=3 * 40 * 2, n_test=60)
+    base = dict(
+        n_clients=3, batch=40, nloop=5, nadmm=3, max_groups=1, model="net",
+        check_results=False, synthetic_ok=True,
+    )
+    d = tempfile.mkdtemp(prefix="bench_flight_")
+    times = {}
+    try:
+        for on in (True, False):
+            cfg = get_preset(
+                "fedavg",
+                flight_recorder=on,
+                metrics_stream=os.path.join(d, f"flight_{int(on)}.jsonl"),
+                **base,
+            )
+            tr = Trainer(cfg, verbose=False, source=src)
+            gid = tr.group_order[0]
+            tr.run_round(0, gid)  # warmup: compile-dominated
+            dts = []
+            for nloop in range(1, 4):
+                t0 = time.perf_counter()
+                tr.run_round(nloop, gid)
+                dts.append(time.perf_counter() - t0)
+            times[on] = float(np.median(dts))
+            tr.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    peak = host_rss_peak_bytes()
+    return {
+        "round_time_flight_on_s": round(times[True], 4),
+        "round_time_flight_off_s": round(times[False], 4),
+        "flight_recorder_overhead_s": round(times[True] - times[False], 4),
+        "memory_rss_peak_mb": (
+            round(peak / 2**20, 1) if peak is not None else None
+        ),
+    }
+
+
 def main() -> None:
     bench_device = os.environ.get("BENCH_DEVICE", "")
     if bench_device == "cpu":
@@ -800,6 +862,12 @@ def main() -> None:
         out["health"] = _health_probe()
     except Exception as e:  # a failed probe must not kill the bench
         out["health"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # ---- the flight probe: recorder overhead + peak host RSS ----
+    try:
+        out["flight"] = _flight_probe()
+    except Exception as e:  # a failed probe must not kill the bench
+        out["flight"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # ---- the utilization sweep: batch and model-size levers ----
     # (round-2 VERDICT: "no row anywhere shows MFU climbing with batch or
@@ -996,6 +1064,16 @@ def main() -> None:
     # no device work; scheduler noise can read slightly negative)
     headline["health_overhead_s"] = out.get("health", {}).get(
         "health_overhead_s"
+    )
+    # the flight-recorder facts (obs/flight.py PR): per-warm-round wall
+    # the always-on incident ring costs — the ≈ 0 gate, measured with
+    # the stream sink live on both sides — and the bench process's peak
+    # host RSS (obs/memory.py), ROADMAP item 4's bounded-RSS evidence
+    headline["flight_recorder_overhead_s"] = out.get("flight", {}).get(
+        "flight_recorder_overhead_s"
+    )
+    headline["memory_rss_peak_mb"] = out.get("flight", {}).get(
+        "memory_rss_peak_mb"
     )
     if "mxu_probe" in out:
         headline["mxu_pct_peak"] = out["mxu_probe"]["pct_peak"]

@@ -93,16 +93,35 @@ record counts, exact communicated bytes vs the full-model-exchange and
 ship-the-data baselines, dispatch and recompile counts, and the health
 verdict.
 
-Cross-run analysis is its own verb (obs/registry.py — pure host-side
-file analysis, no accelerator backend init, so it runs on any host):
+Self-monitoring ops (obs/flight.py, obs/memory.py — the flight-recorder
+PR): with `--metrics-stream` set, a bounded flight ring mirrors the last
+`--flight-window` rounds of the stream and dumps a self-contained
+`incident-<nloop>-<round>.json` bundle into `<stream>.incidents/`
+whenever the health engine fires (loss explosion/plateau, rollback,
+quarantine burst, deadline-miss spike) or the run dies mid-flight
+(`--no-flight-recorder` to disable); every round records host RSS +
+per-device allocator stats as the process-local `memory` series
+(`--no-memory-telemetry`); and `--profile-on-anomaly DIR` runs the round
+after a health alert under a jax.profiler trace window, bounded by
+`--profile-budget N` captures — profiling that costs nothing until
+something is wrong.
+
+Cross-run analysis and live ops are their own verbs (obs/registry.py,
+obs/console.py — pure host-side file analysis, no accelerator backend
+init, so they run on any host):
 
     python -m federated_pytorch_test_tpu report runs/ --json report.json
+    python -m federated_pytorch_test_tpu watch runs/ [--once] [--interval S]
 
-ingests a directory of `--metrics-stream` files (validating each header
-like resume does, refusing foreign streams), aligns the runs on round
-index, and emits comparison tables plus the convergence-vs-bytes
-frontier (accuracy vs cumulative `comm_bytes` per run) as JSON and
-markdown — a codec/combiner/deadline sweep becomes one command.
+`report` ingests a directory of `--metrics-stream` files (validating
+each header like resume does, refusing foreign streams), aligns the
+runs on round index, and emits comparison tables plus the
+convergence-vs-bytes frontier (accuracy vs cumulative `comm_bytes` per
+run) as JSON and markdown — a codec/combiner/deadline sweep becomes one
+command; `--incidents` adds the cross-run incident-bundle table.
+`watch` tails the same streams through the same validated ingestion and
+renders a refreshing terminal dashboard — sparklines, health, comm,
+fleet counters, memory, incidents.
 """
 
 from __future__ import annotations
@@ -218,6 +237,36 @@ def _print_summary(recorder, cfg) -> None:
             # the online tail estimate item 4's learned deadlines consume
             line += f"; client_time p95~{ct['p50']:g}s"
         print(line)
+    mem = recorder.latest("memory")
+    if mem is not None and mem.get("rss_bytes"):
+        line = f"# memory: rss {mem['rss_bytes'] / 2**20:,.0f} MiB"
+        if mem.get("peak_rss_bytes"):
+            line += f" (peak {mem['peak_rss_bytes'] / 2**20:,.0f} MiB)"
+        devs = [
+            f"dev{i}={d['bytes_in_use'] / 2**20:,.0f} MiB"
+            for i, d in enumerate(mem.get("devices") or [])
+            if d and d.get("bytes_in_use") is not None
+        ]
+        if devs:
+            line += "; " + ", ".join(devs)
+        print(line)
+    incidents = recorder.series.get("incident", [])
+    if incidents:
+        kinds = sorted(
+            {k for r in incidents for k in r["value"].get("kinds", ())}
+        )
+        bundles = ", ".join(r["value"]["bundle"] for r in incidents)
+        print(
+            f"# incidents: {len(incidents)} bundle(s) "
+            f"[{','.join(kinds)}] -> {bundles} "
+            f"(under {cfg.metrics_stream}.incidents/)"
+        )
+    captures = recorder.series.get("profile_capture", [])
+    if captures:
+        print(
+            f"# profiler: {len(captures)} anomaly-triggered capture(s) "
+            f"under {cfg.profile_on_anomaly}"
+        )
     roof = recorder.latest("roofline")
     if roof is not None:
         line = f"# roofline: wall {roof['wall_s']}s/round"
@@ -252,6 +301,13 @@ def main(argv=None) -> int:
         from federated_pytorch_test_tpu.obs.registry import report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "watch":
+        # the live console verb (obs/console.py): same backend-free
+        # dispatch rule as `report` — a dashboard must never block on
+        # accelerator init while tailing someone else's run
+        from federated_pytorch_test_tpu.obs.console import watch_main
+
+        return watch_main(argv[1:])
 
     from federated_pytorch_test_tpu.engine import (
         PRESETS,

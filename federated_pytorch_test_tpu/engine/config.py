@@ -369,8 +369,37 @@ class ExperimentConfig:
     # run may flip them and still splice — Trainer._stream_tag).
     health_monitor: bool = True
     # completed partition rounds in the monitor's anomaly window (rates,
-    # loss explosion/plateau detection)
+    # loss explosion/plateau + quarantine-burst/deadline-miss-spike
+    # detection)
     health_window: int = 8
+    # flight recorder (obs/flight.py): a bounded ring over exactly the
+    # records the JSONL sink persists, dumped as a self-contained
+    # `incident-<nloop>-<round>.json` bundle (beside the stream, in
+    # `<stream>.incidents/`) whenever the health engine fires an anomaly
+    # or the process dies mid-run. Rides `--metrics-stream` (the ring
+    # mirrors the sink feed — no stream, nothing to mirror); incidents
+    # are process facts (the `incident` series is stream=False), so
+    # crash+resume twin streams stay byte-identical. ANALYSIS-ONLY knobs
+    # like the health pair: excluded from the stream tag.
+    flight_recorder: bool = True
+    # completed partition rounds the ring retains (= the rounds an
+    # incident bundle holds)
+    flight_window: int = 8
+    # per-round memory telemetry (obs/memory.py): host RSS + per-device
+    # allocator stats as the `memory` series — process facts, recorded
+    # stream=False (a resumed run's RSS has nothing to do with the
+    # crashed one's), surfaced live through the `<stream>.status.json`
+    # sidecar the `watch` console reads. Zero device dispatches.
+    memory_telemetry: bool = True
+    # anomaly-triggered device profiling: the round AFTER a health alert
+    # runs under a jax.profiler trace window written beneath this
+    # directory (`round-<nloop>-<group>/`) — profiling that costs
+    # nothing until something is wrong. Bounded by `profile_budget`
+    # captures per process. Mutually exclusive with `profile_dir` (the
+    # whole-run trace — jax.profiler windows cannot nest). None = off.
+    profile_on_anomaly: str | None = None
+    # per-process cap on anomaly-triggered profiler captures
+    profile_budget: int = 3
 
     # failure detection (SURVEY.md §5 — absent in the reference): check
     # per-client losses each epoch and per-client parameter finiteness
@@ -683,6 +712,58 @@ class ExperimentConfig:
         if self.health_window < 1:
             raise ValueError(
                 f"health_window must be >= 1, got {self.health_window}"
+            )
+        # strict int checks in the linesearch_probes style: a bool quacks
+        # as an int and must be rejected naming the field
+        if not isinstance(self.flight_window, int) or isinstance(
+            self.flight_window, bool
+        ):
+            raise ValueError(
+                f"flight_window must be an int >= 1, "
+                f"got {self.flight_window!r}"
+            )
+        if self.flight_window < 1:
+            raise ValueError(
+                f"flight_window must be >= 1, got {self.flight_window}"
+            )
+        if not isinstance(self.profile_budget, int) or isinstance(
+            self.profile_budget, bool
+        ):
+            raise ValueError(
+                f"profile_budget must be an int >= 1, "
+                f"got {self.profile_budget!r}"
+            )
+        if self.profile_budget < 1:
+            raise ValueError(
+                f"profile_budget must be >= 1, got {self.profile_budget}"
+            )
+        if self.profile_on_anomaly is not None and self.profile_dir is not None:
+            raise ValueError(
+                "profile_on_anomaly and profile_dir are mutually "
+                "exclusive: the whole-run jax.profiler trace cannot nest "
+                "an anomaly-triggered capture window inside itself"
+            )
+        if self.profile_on_anomaly is not None and not self.health_monitor:
+            raise ValueError(
+                "profile_on_anomaly requires the health monitor: captures "
+                "are armed by health anomalies, so with "
+                "health_monitor=False the knob could never fire (a config "
+                "mistake, not a no-op)"
+            )
+        # a budget without the trigger directory is a config mistake,
+        # not a no-op (the cohort-knob rule above)
+        budget_default = type(self).__dataclass_fields__[
+            "profile_budget"
+        ].default
+        if (
+            self.profile_budget != budget_default
+            and self.profile_on_anomaly is None
+        ):
+            raise ValueError(
+                "profile_budget requires profile_on_anomaly (the budget "
+                "bounds anomaly-triggered profiler captures), got "
+                f"profile_budget={self.profile_budget!r} with "
+                "profile_on_anomaly=None"
             )
         if self.robust_agg not in ROBUST_METHODS:
             raise ValueError(

@@ -28,6 +28,7 @@ survivors' state back before the loop's stream marker and checkpoint.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -68,11 +69,15 @@ from federated_pytorch_test_tpu.obs import (
     CommLedger,
     DeadlineController,
     DispatchCounter,
+    FlightRecorder,
     HealthEngine,
     JsonlSink,
     TraceRecorder,
+    incidents_dir,
+    memory_record,
     roofline_record,
 )
+from federated_pytorch_test_tpu.obs.sinks import jsonable
 from jax.sharding import NamedSharding, PartitionSpec
 
 from federated_pytorch_test_tpu.parallel import (
@@ -124,6 +129,12 @@ class Trainer:
         size must divide `cfg.n_clients`)."""
         self.cfg = cfg
         self.recorder = MetricsRecorder(verbose=verbose)
+        # run-lifecycle flags (obs/flight.py crash dumps): `close()` only
+        # writes a crash bundle for a run that ENTERED `run()` and never
+        # completed — benchmarks driving `run_round` by hand and then
+        # closing must not leave phantom incidents
+        self._run_started = False
+        self._run_completed = False
 
         if cfg.compile_cache:
             # persistent XLA executable cache (`--compile-cache DIR`):
@@ -528,6 +539,46 @@ class Trainer:
             # replayed rounds will not re-run: seed the ledger's totals
             # so the end-of-run comm summary covers the whole run
             self._comm.absorb(self.recorder.series.get("comm_bytes", []))
+        # flight recorder (obs/flight.py): a SINK beside the JSONL one,
+        # so its ring mirrors exactly the resolved records the stream
+        # persists (observers would see unharvested Deferred values and
+        # rollback-discarded evals). Replay rebuilds the ring + the
+        # anomaly rising-edge state; open() clears stale bundles — all
+        # of them on a fresh stream, those at or past the restore loop
+        # on resume (their rounds re-run and re-dump identically).
+        self._flight = None
+        if (
+            cfg.flight_recorder
+            and cfg.metrics_stream
+            and jax.process_index() == 0
+        ):
+            self._flight = FlightRecorder(
+                window=cfg.flight_window,
+                dir=incidents_dir(cfg.metrics_stream),
+                tag=self._stream_tag(),
+            )
+            self._flight.open(
+                resume_nloops=self._completed_nloops
+                if cfg.resume == "auto"
+                else None
+            )
+            if replay:
+                self._flight.replay(replay)
+            self.recorder.sinks.append(self._flight)
+        # anomaly-triggered device profiling (`--profile-on-anomaly`):
+        # armed at an anomalous round boundary, captures the NEXT round
+        # under a jax.profiler window, bounded per process
+        self._profile_pending = False
+        self._profile_captures = 0
+        # live status sidecar for the `watch` console (obs/console.py):
+        # memory and the current cursor are process facts that never
+        # enter the stream, so they surface through this atomically
+        # rewritten file instead
+        self._status_path = (
+            cfg.metrics_stream + ".status.json"
+            if cfg.metrics_stream and jax.process_index() == 0
+            else None
+        )
         # in-run health engine (obs/health.py): a pure observer of the
         # streamed records — zero device dispatches. Replay BEFORE
         # attaching: the replayed records rebuild sketch/window state, so
@@ -703,11 +754,16 @@ class Trainer:
         # analysis-only (a pure observer of the records — never
         # trajectory-changing), so like the dispatch-shape knobs a
         # resumed run may flip them and still splice
-        # (tests/test_health.py splice-accepted regression).
+        # (tests/test_health.py splice-accepted regression). The flight/
+        # memory/profiler knobs are analysis-only in the same sense:
+        # rings, bundles, RSS reads, and profiler windows never touch
+        # the trajectory (tests/test_flight.py).
         for k in (
             "metrics_stream", "trace_out", "profile_dir", "resume",
             "compile_cache", "fold_eval", "async_eval",
             "health_monitor", "health_window",
+            "flight_recorder", "flight_window", "memory_telemetry",
+            "profile_on_anomaly", "profile_budget",
         ):
             d.pop(k, None)
         cfg_tag = hashlib.md5(
@@ -1954,11 +2010,16 @@ class Trainer:
 
         This wrapper is the round's observability boundary (obs/): one
         trace span covering the round, per-round `dispatch_count` /
-        `recompile_count` deltas, the `--diagnostics-every` cadence, and
-        the per-round sink flush. An injected crash skips the per-round
-        counters (their round never completed; the resumed run re-records
-        it) but still flushes, so the crashed stream holds everything the
-        round logged.
+        `recompile_count` deltas, the `--diagnostics-every` cadence, the
+        health digest + `memory` record, the flight recorder's incident
+        dump, the anomaly-armed profiler window, the `watch` status
+        sidecar, and the per-round sink flush. The `health` record is
+        logged BEFORE `dispatch_count`, which is therefore the round's
+        FINAL streamed record in both trainer paths — the flight ring's
+        segmentation boundary (obs/flight.py). An injected crash skips
+        the per-round counters (their round never completed; the resumed
+        run re-records it) but still flushes, so the crashed stream
+        holds everything the round logged.
         """
         before = self._dispatch.snapshot()
         compiled_before = self._dispatch.compiled_programs()
@@ -1969,14 +2030,39 @@ class Trainer:
             # same position in both trainer paths, so fused and unfused
             # runs decide from the identical prefix
             self._decide_deadline(nloop, gid)
+        # anomaly-armed profiler window (`--profile-on-anomaly DIR`): the
+        # PREVIOUS round's health alert armed it; capture this round
+        # under a jax.profiler trace, bounded by the per-process budget —
+        # profiling that costs nothing until something is wrong
+        prof_cm = contextlib.nullcontext()
+        prof_dir = None
+        if self._profile_pending:
+            self._profile_pending = False
+            if self._profile_captures < self.cfg.profile_budget:
+                prof_dir = os.path.join(
+                    self.cfg.profile_on_anomaly, f"round-{nloop}-{gid}"
+                )
+                os.makedirs(prof_dir, exist_ok=True)
+                prof_cm = jax.profiler.trace(prof_dir)
+                self._profile_captures += 1
         try:
-            with self.recorder.phase("round", record=False, nloop=nloop, group=gid):
-                if self._fused_enabled():
-                    self._run_round_fused(nloop, gid)
-                else:
-                    self._run_round_unfused(nloop, gid)
+            with prof_cm:
+                with self.recorder.phase(
+                    "round", record=False, nloop=nloop, group=gid
+                ):
+                    if self._fused_enabled():
+                        self._run_round_fused(nloop, gid)
+                    else:
+                        self._run_round_unfused(nloop, gid)
         finally:
             self.recorder.flush()
+        if prof_dir is not None:
+            # a capture path is a fact about THIS process (a resumed run
+            # re-arms from its own alerts): stream=False, like roofline
+            self.recorder.log(
+                "profile_capture", {"dir": prof_dir}, stream=False,
+                nloop=nloop, group=gid,
+            )
         self._rounds_done += 1
         # the diagnostics sample runs BEFORE the delta is taken, so its
         # dispatch (and first-use compile) land in THIS round's
@@ -1992,6 +2078,30 @@ class Trainer:
             and self._rounds_done % every == 0
         ):
             self._record_group_distances(nloop, gid)
+        # the round's health digest (obs/health.py): sketches + windowed
+        # rates over the records logged above, no device work. A crashed
+        # round never reaches this (like the counters) — the resumed run
+        # re-records it, and the stream replay rebuilt the engine's state
+        # so the re-recorded value matches an uninterrupted twin's.
+        # Logged BEFORE dispatch_count: the counter record must stay the
+        # round's final streamed line (the flight ring's boundary).
+        anomalies: list = []
+        if self._health_engine is not None:
+            hval, anomalies = self._health_engine.round_record()
+            self.recorder.log("health", hval, nloop=nloop, group=gid)
+            if self.recorder.tracer is not None:
+                for kind in anomalies:
+                    self.recorder.tracer.instant(
+                        f"health:{kind}", nloop=nloop, group=gid
+                    )
+        if self.cfg.memory_telemetry:
+            # host RSS + device allocator stats (obs/memory.py): host
+            # reads only, zero dispatches; a process fact, so
+            # stream=False keeps twin streams byte-identical
+            self.recorder.log(
+                "memory", memory_record(), stream=False,
+                nloop=nloop, group=gid,
+            )
         self.recorder.log(
             "dispatch_count",
             self._dispatch.delta_since(before),
@@ -2007,22 +2117,134 @@ class Trainer:
             nloop=nloop,
             group=gid,
         )
-        # the round's health digest (obs/health.py): sketches + windowed
-        # rates over the records logged above, no device work. A crashed
-        # round never reaches this (like the counters) — the resumed run
-        # re-records it, and the stream replay rebuilt the engine's state
-        # so the re-recorded value matches an uninterrupted twin's.
-        if self._health_engine is not None:
-            hval, anomalies = self._health_engine.round_record()
-            self.recorder.log("health", hval, nloop=nloop, group=gid)
-            if self.recorder.tracer is not None:
-                for kind in anomalies:
-                    self.recorder.tracer.instant(
-                        f"health:{kind}", nloop=nloop, group=gid
-                    )
         if self.recorder.tracer is not None:
             self.recorder.tracer.counter("dispatches", self._dispatch.counts)
         self.recorder.flush()
+        if anomalies:
+            if self.cfg.profile_on_anomaly:
+                # capture the NEXT round (this one already ran)
+                self._profile_pending = True
+            if self._flight is not None:
+                # the ring just closed this round's bucket
+                # (dispatch_count above) — dump the incident bundle, the
+                # triggering round last in it. The `incident` record is
+                # a process fact (the bundle is a file beside the
+                # stream): stream=False, twin streams untouched.
+                path = self._flight.incident(
+                    anomalies,
+                    nloop=nloop,
+                    group=gid,
+                    round_ix=self._rounds_done - 1,
+                    # bound method, not a call: the extras (plan slice,
+                    # decision memos) are only built when the bundle
+                    # actually dumps — a chronic anomaly dedupes first
+                    extra=self._incident_extra,
+                )
+                if path is not None:
+                    self.recorder.log(
+                        "incident",
+                        {
+                            "kinds": list(anomalies),
+                            "bundle": os.path.basename(path),
+                            "round": self._rounds_done - 1,
+                        },
+                        stream=False,
+                        nloop=nloop,
+                        group=gid,
+                    )
+                    if self.recorder.tracer is not None:
+                        self.recorder.tracer.instant(
+                            "incident", kinds=list(anomalies),
+                            nloop=nloop, group=gid,
+                        )
+                    if self.recorder.verbose:
+                        print(
+                            f"INCIDENT kinds={list(anomalies)} "
+                            f"bundle={path}"
+                        )
+        if self._status_path is not None:
+            self._write_status(nloop, gid)
+
+    def _incident_extra(self) -> dict:
+        """The non-ring half of an incident bundle (obs/flight.py): the
+        deadline/schedule decision memos, the fault plan's slice over
+        the in-ring rounds, and the latest `memory` record — everything
+        a postmortem reaches for beyond the raw series, self-contained
+        in the one file."""
+        extra: dict = {
+            "decisions": {
+                "deadline": {
+                    f"{n}:{g}": s
+                    for (n, g), s in sorted(self._deadline_decisions.items())
+                },
+                "schedule": {
+                    f"{n}:{s}": dict(v)
+                    for (n, s), v in sorted(self._schedule_decisions.items())
+                },
+            },
+            "memory": self.recorder.latest("memory"),
+            "fault_plan": None,
+        }
+        if self.injector is not None:
+            sl: dict = {}
+            for bucket in self._flight.rounds() if self._flight else ():
+                n, g = bucket.get("nloop"), bucket.get("group")
+                if n is None or g is None:
+                    continue
+                per_round: dict = {}
+                modes = None
+                if self.injector.has_corruption:
+                    modes = self.injector.corruption_for_round(
+                        int(n), int(g), self.cfg.nadmm
+                    )[0]
+                for a in range(self.cfg.nadmm):
+                    row: dict = {}
+                    mask = self._vslice(
+                        self.injector.mask(int(n), int(g), a), int(n)
+                    )
+                    dropped = np.where(mask == 0.0)[0]
+                    if dropped.size:
+                        row["dropped"] = [int(i) for i in dropped]
+                    if modes is not None:
+                        corrupted = np.where(
+                            self._vslice(modes[a], int(n)) != 0
+                        )[0]
+                        if corrupted.size:
+                            row["corrupted"] = [int(i) for i in corrupted]
+                    if row:
+                        per_round[str(a)] = row
+                if per_round:
+                    sl[f"{int(n)}:{int(g)}"] = per_round
+            extra["fault_plan"] = {
+                "spec": self.cfg.fault_plan,
+                "tag": self.injector.plan_tag,
+                "slice": sl,
+            }
+        return extra
+
+    def _write_status(self, nloop: int, gid: int) -> None:
+        """Atomically rewrite the `watch` console's live sidecar
+        (`<stream>.status.json`): the current cursor plus the process
+        facts — memory, profiler captures, incident count — that never
+        enter the stream (obs/console.py reads it; a torn or missing
+        file degrades to no panel, never an error)."""
+        doc = {
+            "nloop": int(nloop),
+            "group": int(gid),
+            "rounds_done": int(self._rounds_done),
+            "nloops_total": int(self.cfg.nloop),
+            "memory": self.recorder.latest("memory"),
+            "deadline": self._deadline_for(nloop, gid),
+            "incidents": len(self.recorder.series.get("incident", [])),
+            "profile_captures": int(self._profile_captures),
+        }
+        tmp = self._status_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=jsonable)
+            os.replace(tmp, self._status_path)
+        except OSError:
+            pass  # a read-only run dir must not kill the round
 
     def _record_group_distances(self, nloop: int, gid: int) -> None:
         """Sample `parallel/diagnostics.py group_distances` into the
@@ -2734,17 +2956,58 @@ class Trainer:
         trace-event JSON (obs/trace.py), written even when the run dies on
         an injected crash so the chaos timeline survives for post-mortem.
         """
+        self._run_started = True
         try:
             if self.cfg.profile_dir:
                 with jax.profiler.trace(self.cfg.profile_dir):
-                    return self._run_impl()
-            return self._run_impl()
+                    out = self._run_impl()
+            else:
+                out = self._run_impl()
+            self._run_completed = True
+            return out
         finally:
             self.close()
 
     def close(self) -> None:
-        """Flush and close the observability outputs (idempotent): write
-        the Chrome trace atomically, flush and close the metric sinks."""
+        """Flush and close the observability outputs (idempotent): dump
+        the flight recorder's crash bundle when a started run never
+        completed, write the Chrome trace atomically, flush and close
+        the metric sinks."""
+        if (
+            self._flight is not None
+            and self._run_started
+            and not self._run_completed
+        ):
+            try:
+                path = self._flight.crash_dump(
+                    nloop=self._completed_nloops,
+                    round_ix=self._rounds_done,
+                    extra=self._incident_extra,
+                )
+                if path is not None and self.recorder.verbose:
+                    print(f"INCIDENT kinds=['crash'] bundle={path}")
+            except Exception as e:  # same rule as the trace write below:
+                # the dying run's own outcome must not be masked
+                import warnings
+
+                warnings.warn(f"could not write crash incident: {e}")
+        if self._status_path is not None and self._run_started:
+            # stamp the sidecar's terminal state (the `watch` console's
+            # live/finished/crashed discriminator — a stale sidecar must
+            # not read as a live run forever)
+            try:
+                with open(self._status_path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {}
+            doc["completed" if self._run_completed else "crashed"] = True
+            tmp = self._status_path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, default=jsonable)
+                os.replace(tmp, self._status_path)
+            except OSError:
+                pass
         if self.recorder.tracer is not None and self.cfg.trace_out:
             try:
                 self.recorder.tracer.save(self.cfg.trace_out)

@@ -1,6 +1,7 @@
-"""Observability: sinks, comm ledger, traces, health, roofline, registry.
+"""Observability: sinks, ledger, traces, health, roofline, registry,
+flight recorder, memory telemetry, live console.
 
-Six pillars over the structured metric store (`utils/metrics.py`):
+Nine pillars over the structured metric store (`utils/metrics.py`):
 
 * `JsonlSink` — a crash-safe append-only JSONL metric stream with
   per-outer-loop commit markers; `resume='auto'` replays it and truncates
@@ -25,9 +26,27 @@ Six pillars over the structured metric store (`utils/metrics.py`):
 * `RunRegistry` — the cross-run experiment registry behind the
   `python -m federated_pytorch_test_tpu report` CLI: validated stream
   ingestion, round-aligned comparisons, and the convergence-vs-bytes
-  frontier (registry.py).
+  frontier (registry.py);
+* `FlightRecorder` — a bounded ring over exactly the records the JSONL
+  sink persists, dumped as self-contained `incident-<nloop>-<round>.json`
+  bundles when the health engine fires or the process dies mid-run
+  (flight.py; `report --incidents` tables them);
+* `memory_record` / `host_rss_peak_bytes` — host RSS + per-device
+  allocator stats as the process-local `memory` series and the
+  bounded-RSS evidence ROADMAP item 4 gates on (memory.py);
+* `watch_main` — the `watch` CLI verb: a refreshing terminal dashboard
+  tailing metric streams through the registry's validated ingestion
+  (console.py).
 """
 
+from federated_pytorch_test_tpu.obs.console import render, watch_main
+from federated_pytorch_test_tpu.obs.flight import (
+    MAX_INCIDENTS,
+    FlightRecorder,
+    incidents_dir,
+    list_incidents,
+    validate_incident,
+)
 from federated_pytorch_test_tpu.obs.health import (
     DEADLINE_WARMUP_OBS,
     DeadlineController,
@@ -36,6 +55,12 @@ from federated_pytorch_test_tpu.obs.health import (
     PercentileSketch,
 )
 from federated_pytorch_test_tpu.obs.ledger import CommLedger
+from federated_pytorch_test_tpu.obs.memory import (
+    device_memory_stats,
+    host_rss_bytes,
+    host_rss_peak_bytes,
+    memory_record,
+)
 from federated_pytorch_test_tpu.obs.registry import (
     RunRegistry,
     StreamRefused,
@@ -58,17 +83,28 @@ __all__ = [
     "DEADLINE_WARMUP_OBS",
     "DeadlineController",
     "DispatchCounter",
+    "FlightRecorder",
     "HealthEngine",
     "JsonlSink",
+    "MAX_INCIDENTS",
     "P2Quantile",
     "PercentileSketch",
     "RunRegistry",
     "StreamRefused",
     "TraceRecorder",
     "chip_peaks",
+    "device_memory_stats",
+    "host_rss_bytes",
+    "host_rss_peak_bytes",
+    "incidents_dir",
     "lbfgs_round_cost",
+    "list_incidents",
+    "memory_record",
     "read_stream",
+    "render",
     "render_markdown",
     "report_main",
     "roofline_record",
+    "validate_incident",
+    "watch_main",
 ]

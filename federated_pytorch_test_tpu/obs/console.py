@@ -1,0 +1,322 @@
+"""Live fleet console: `python -m federated_pytorch_test_tpu watch DIR`.
+
+`report` (obs/registry.py) is the post-hoc verb; nothing watched a run
+WHILE it ran — the operator tailing a JSONL stream by eye is the gap
+this module closes. `watch` re-reads a directory (or one file) of
+`--metrics-stream` files every `--interval` seconds through the
+registry's validated ingestion — the SAME parser `report` and resume
+use, so torn tails from a crash mid-write are tolerated and foreign
+headers are refused, never half-read — and renders a refreshing
+terminal dashboard per run:
+
+* accuracy and per-round mean-loss sparklines (the tail, newest right),
+* health verdict (rounds monitored, anomalies, the last round's kinds),
+* comm uplink + bytes the adaptive scheduler saved by skipping,
+* fleet counters: quarantined clients, churn absences, cohort size,
+  the current deadline decision,
+* memory (host RSS + device bytes) from the trainer's
+  `<stream>.status.json` sidecar — memory is a process fact that never
+  enters the stream (obs/memory.py), so the sidecar is its live surface,
+* incident-bundle count + names from `<stream>.incidents/`
+  (obs/flight.py).
+
+`--once` renders a single frame and exits (the scriptable/CI mode —
+the tier-2 incident smoke gates on it); otherwise the screen refreshes
+in place until Ctrl-C. Like `report`, the verb is dispatched before the
+engine import chain and never initializes an accelerator backend — it
+runs on any host, including one whose TPU runtime would block on init.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from federated_pytorch_test_tpu.obs.flight import list_incidents
+from federated_pytorch_test_tpu.obs.registry import (
+    RunRegistry,
+    RunStream,
+    StreamRefused,
+)
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(xs, width: int = 40) -> str:
+    """Unicode block sparkline of the series TAIL (the console is about
+    now, not history); constant series render flat-low, non-finite
+    values (a poisoned round's NaN losses) are dropped."""
+    xs = [
+        float(x)
+        for x in xs
+        if x is not None and math.isfinite(float(x))
+    ]
+    if not xs:
+        return "-"
+    xs = xs[-width:]
+    lo, hi = min(xs), max(xs)
+    if hi <= lo:
+        return _BLOCKS[0] * len(xs)
+    return "".join(
+        _BLOCKS[min(len(_BLOCKS) - 1, int((x - lo) / (hi - lo) * len(_BLOCKS)))]
+        for x in xs
+    )
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024.0 or unit == "TiB":
+            return f"{n:,.0f} {unit}" if unit == "B" else f"{n:,.1f} {unit}"
+        n /= 1024.0
+    return "-"
+
+
+def _run_view(run: RunStream) -> dict:
+    """One pass over a stream's records → everything a dashboard panel
+    needs (content-only; wall-clock fields are never read)."""
+    v = {
+        "label": run.label or "?",
+        "records": len(run.records),
+        "loops_committed": len(run.markers),
+        "loss_per_round": [],  # per-round mean train loss (sparkline data)
+        "last_loss": None,
+        "acc_curve": [],
+        "comm_bytes": 0,
+        "bytes_saved": 0,
+        "quarantined": 0,
+        "churn_absent": None,
+        "cohort": None,
+        "deadline": None,
+        "health_rounds": 0,
+        "health_anomalies": 0,
+        "last_anomalies": [],
+    }
+    loss_sum, loss_n = 0.0, 0
+    for series, rec in run.records:
+        val = rec.get("value")
+        if series == "train_loss" and isinstance(val, list):
+            finite = [
+                float(x)
+                for x in val
+                if isinstance(x, (int, float)) and math.isfinite(float(x))
+            ]
+            if finite:
+                loss_sum += sum(finite) / len(finite)
+                loss_n += 1
+                v["last_loss"] = sum(finite) / len(finite)
+        elif series == "test_accuracy" and isinstance(val, list):
+            accs = [float(x) for x in val if isinstance(x, (int, float))]
+            if accs:
+                v["acc_curve"].append(sum(accs) / len(accs))
+        elif series == "comm_bytes":
+            v["comm_bytes"] += int(val)
+        elif series == "group_schedule" and isinstance(val, dict):
+            if val.get("skipped"):
+                v["bytes_saved"] += int(val.get("saved_bytes", 0))
+        elif series == "quarantine" and isinstance(val, dict):
+            v["quarantined"] += len(val.get("clients", ()))
+        elif series == "availability" and isinstance(val, dict):
+            v["churn_absent"] = val.get("absent")
+        elif series == "cohort" and isinstance(val, dict):
+            v["cohort"] = len(val.get("clients", ()))
+        elif series == "deadline" and isinstance(val, dict):
+            v["deadline"] = val
+        elif series == "dispatch_count":
+            # round boundary (the flight recorder's segmentation rule):
+            # fold the round's mean loss into the sparkline series
+            if loss_n:
+                v["loss_per_round"].append(loss_sum / loss_n)
+            loss_sum, loss_n = 0.0, 0
+        elif series == "health" and isinstance(val, dict):
+            v["health_rounds"] += 1
+            an = list(val.get("anomalies", ()))
+            v["health_anomalies"] += len(an)
+            v["last_anomalies"] = an
+    return v
+
+
+def _read_status(stream_path: str) -> Optional[dict]:
+    """The trainer's atomically-rewritten live sidecar (memory, current
+    cursor) — absent or torn reads degrade to None, never an error."""
+    try:
+        with open(stream_path + ".status.json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _render_run(name: str, run: RunStream) -> List[str]:
+    v = _run_view(run)
+    status = _read_status(run.path)
+    # the sidecar's completed flag is what separates a finished run from
+    # a live one — without it a stale sidecar reads as live forever
+    state = ""
+    if status is not None:
+        if status.get("completed"):
+            state = "  (completed)"
+        elif status.get("crashed"):
+            state = "  (crashed)"
+        else:
+            state = "  (live)"
+    lines = [
+        f"== {name}  [{v['label']}]  loops committed: "
+        f"{v['loops_committed']}  records: {v['records']}{state}"
+    ]
+    loss = f"{v['last_loss']:.4g}" if v["last_loss"] is not None else "-"
+    lines.append(
+        f"   loss  {loss:>10}  {sparkline(v['loss_per_round'])}"
+    )
+    acc = f"{v['acc_curve'][-1]:.4f}" if v["acc_curve"] else "-"
+    lines.append(
+        f"   acc   {acc:>10}  {sparkline(v['acc_curve'])}"
+        f"  ({len(v['acc_curve'])} evals)"
+    )
+    last = f" last: {','.join(v['last_anomalies'])}" if v["last_anomalies"] else ""
+    lines.append(
+        f"   health {v['health_rounds']} rounds monitored, "
+        f"{v['health_anomalies']} anomalies{last}"
+    )
+    comm = f"   comm  {_fmt_bytes(v['comm_bytes'])} uplink"
+    if v["bytes_saved"]:
+        comm += f" (+{_fmt_bytes(v['bytes_saved'])} saved by skipping)"
+    lines.append(comm)
+    fleet = [f"quarantined {v['quarantined']}"]
+    if v["deadline"] is not None:
+        dl = v["deadline"]
+        fleet.append(
+            f"deadline {dl.get('seconds')}s ({dl.get('source', '?')})"
+        )
+    if v["churn_absent"] is not None:
+        fleet.append(f"churn absent {v['churn_absent']}")
+    if v["cohort"] is not None:
+        fleet.append(f"cohort {v['cohort']}")
+    lines.append("   fleet " + " | ".join(fleet))
+    if status is not None:
+        mem = status.get("memory") or {}
+        parts = []
+        if mem.get("rss_bytes"):
+            parts.append(f"rss {_fmt_bytes(mem['rss_bytes'])}")
+        if mem.get("peak_rss_bytes"):
+            parts.append(f"peak {_fmt_bytes(mem['peak_rss_bytes'])}")
+        for i, dev in enumerate(mem.get("devices") or []):
+            if dev and dev.get("bytes_in_use") is not None:
+                line = f"dev{i} {_fmt_bytes(dev['bytes_in_use'])}"
+                if dev.get("bytes_limit"):
+                    line += f"/{_fmt_bytes(dev['bytes_limit'])}"
+                parts.append(line)
+        if status.get("profile_captures"):
+            parts.append(f"profiler captures {status['profile_captures']}")
+        if parts:
+            lines.append("   memory " + " | ".join(parts))
+    bundles = list_incidents(run.path)
+    if bundles:
+        names = []
+        for fname, doc in bundles:
+            # defensive: a parseable-but-foreign bundle (hand-edited,
+            # other schema) must degrade to a label, never crash the
+            # dashboard — the registry's validate-and-warn is for
+            # `report --incidents`, the console just points at files
+            if not isinstance(doc, dict):
+                names.append(f"{fname}(unreadable)")
+                continue
+            kinds = doc.get("anomalies")
+            label = (
+                ",".join(str(k) for k in kinds)
+                if isinstance(kinds, list) and kinds
+                else str(doc.get("kind", "?"))
+            )
+            names.append(f"{fname}[{label}]")
+        lines.append(f"   incidents {len(bundles)}: {', '.join(names)}")
+    else:
+        lines.append("   incidents 0")
+    return lines
+
+
+def render(
+    target: str, glob: str = "*.jsonl", match: Optional[str] = None
+) -> Tuple[str, int]:
+    """One dashboard frame over `target` (a directory of streams, or one
+    stream file). Returns `(text, run count)`."""
+    reg = RunRegistry(match=match)
+    refused: List[str] = []
+    if os.path.isfile(target):
+        try:
+            reg.ingest(target)
+        except StreamRefused as e:
+            refused.append(str(e))
+    else:
+        refused = reg.ingest_dir(target, pattern=glob)
+    stamp = time.strftime("%H:%M:%S")
+    lines = [
+        f"federated_pytorch_test_tpu watch — {target} "
+        f"({len(reg.runs)} run(s), {stamp})",
+        "",
+    ]
+    if not reg.runs:
+        lines.append(
+            f"no valid metric streams (pattern {glob!r}; "
+            f"{len(refused)} file(s) refused) — waiting for a "
+            "--metrics-stream writer"
+        )
+    for name, run in sorted(reg.runs.items()):
+        lines.extend(_render_run(name, run))
+        lines.append("")
+    return "\n".join(lines) + "\n", len(reg.runs)
+
+
+def watch_main(argv=None) -> int:
+    """`python -m federated_pytorch_test_tpu watch DIR` — pure host-side
+    file tailing; no accelerator backend is ever initialized."""
+    ap = argparse.ArgumentParser(
+        prog="federated_pytorch_test_tpu watch",
+        description=(
+            "Live terminal dashboard over a directory (or one file) of "
+            "--metrics-stream JSONL files: sparklines, health, comm, "
+            "fleet counters, memory, incidents (docs/OBSERVABILITY.md)."
+        ),
+    )
+    ap.add_argument(
+        "dir", help="directory of --metrics-stream files (or one file)"
+    )
+    ap.add_argument(
+        "--glob", default="*.jsonl", help="stream filename pattern"
+    )
+    ap.add_argument(
+        "--match",
+        default=None,
+        help="refuse streams whose header tag lacks this substring",
+    )
+    ap.add_argument(
+        "--once",
+        action="store_true",
+        help="render one frame and exit (scriptable/CI mode)",
+    )
+    ap.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="seconds between refreshes (default 2)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.once:
+        text, n_runs = render(args.dir, args.glob, args.match)
+        print(text, end="")
+        return 0 if n_runs else 1
+    try:
+        while True:
+            text, _ = render(args.dir, args.glob, args.match)
+            # clear + home, then the frame: refresh in place
+            sys.stdout.write("\x1b[2J\x1b[H" + text)
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0

@@ -1,0 +1,329 @@
+"""Flight recorder: a bounded ring over the stream + incident bundles.
+
+The health engine (obs/health.py) DETECTS a bad round; until this module
+the operator's next step was hand-reconstructing the incident from the
+raw JSONL stream — find the round, scrape the surrounding records, guess
+which deadline/schedule decisions and fault-plan rows were live. The
+flight recorder keeps that reconstruction ALREADY DONE, bounded: a ring
+buffer of the last `--flight-window` completed partition rounds' streamed
+records, dumped as one self-contained `incident-<nloop>-<round>.json`
+bundle the moment the health engine fires an anomaly (or when the
+process dies mid-run — `Trainer.close()`'s crash dump).
+
+Design rules:
+
+* **The ring mirrors the SINK stream, not the observer feed.** The
+  recorder notifies observers at log time, BEFORE deferred eval values
+  materialize and before a rollback's `discard_pending` can drop a
+  poisoned round's evals; sinks receive records post-harvest, resolved,
+  in exactly the order the JSONL file persists them. So the flight
+  recorder is a *sink* (record/flush/commit/close protocol): what the
+  bundle holds is byte-for-byte what the stream holds — the acceptance
+  contract "in-bundle series match the stream's last W rounds exactly"
+  falls out of the wiring instead of being an approximation.
+* **One segmentation rule, live and on replay.** The trainer logs
+  `dispatch_count` as the round's FINAL streamed record in both trainer
+  paths (engine/trainer.py run_round — the `health` record precedes
+  it), so seeing one closes the ring's current bucket. A resumed run
+  feeds the sink's replayed records through `replay()` — the same
+  `record()` code path — and re-derives the identical ring the crashed
+  process held at the restore point.
+* **Incidents are process facts.** The `incident` series record is
+  `stream=False` (like `recompile_count`/`roofline`) and the bundle is
+  a separate file, so crash+resume twin stream identity is untouched.
+  Bundles live in `<stream>.incidents/` — per-stream, so sweep
+  directories holding several streams (the report_smoke layout) cannot
+  clobber each other's forensics. On resume, bundles at or past the
+  restore loop are deleted (they describe rounds that will re-run and
+  re-dump identically — the stream-truncation rule applied to files);
+  a fresh stream clears the directory entirely.
+* **Rising-edge dedupe + budget.** A chronic anomaly (a plateaued run
+  plateaus every round) dumps ONCE — a new bundle needs an anomaly
+  kind the previous round did not have — and `MAX_INCIDENTS` caps the
+  per-process total. The edge state derives purely from the `health`
+  records passing through the sink, so a resumed recorder re-decides
+  identically to its uninterrupted twin.
+
+`report --incidents` (obs/registry.py) tables every bundle under a run
+directory; `watch` (obs/console.py) surfaces the count live. Both read
+bundles through `list_incidents`/`validate_incident` here — no jax at
+import time, so the analysis verbs stay backend-free.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from federated_pytorch_test_tpu.obs.sinks import jsonable
+
+INCIDENT_SCHEMA = 1
+
+# per-process cap on anomaly bundles: a pathological run where every
+# round surfaces a new anomaly kind must not fill the disk with
+# forensics (crash dumps are outside the cap — there is at most one)
+MAX_INCIDENTS = 16
+
+# the round's FINAL streamed record in both trainer paths
+# (engine/trainer.py run_round logs it after the health record): seeing
+# one closes the ring's current bucket — the ONE segmentation rule,
+# live and on replay
+BOUNDARY_SERIES = "dispatch_count"
+
+_BUNDLE_RE = re.compile(r"^incident-(\d+)-(\d+)\.json$")
+
+
+def incidents_dir(stream_path: str) -> str:
+    """Where a metric stream's incident bundles live:
+    `<stream>.incidents/` — per-stream, so directories holding several
+    sweep streams cannot clobber each other's bundles."""
+    return stream_path + ".incidents"
+
+
+def list_incidents(stream_path: str) -> List[Tuple[str, Optional[dict]]]:
+    """Sorted `(filename, parsed bundle)` pairs under the stream's
+    incidents directory — numeric (nloop, round) order, so tables are
+    deterministic. An unreadable bundle parses to None (callers decide
+    whether to warn or raise); validation is the caller's via
+    `validate_incident`."""
+    d = incidents_dir(stream_path)
+    if not os.path.isdir(d):
+        return []
+    found = []
+    for fname in os.listdir(d):
+        m = _BUNDLE_RE.match(fname)
+        if m is None:
+            continue
+        found.append((int(m.group(1)), int(m.group(2)), fname))
+    out: List[Tuple[str, Optional[dict]]] = []
+    for _, _, fname in sorted(found):
+        try:
+            with open(os.path.join(d, fname)) as f:
+                out.append((fname, json.load(f)))
+        except (OSError, ValueError):
+            out.append((fname, None))
+    return out
+
+
+def validate_incident(doc: Any) -> None:
+    """Strict incident-bundle schema check (docs/OBSERVABILITY.md):
+    raises ValueError naming the offending field — the house validation
+    style, shared by `report --incidents` and the tier-2 incident
+    smoke."""
+
+    def _fail(field: str, why: str):
+        raise ValueError(f"incident bundle: field {field!r} {why}")
+
+    if not isinstance(doc, dict):
+        raise ValueError("incident bundle: must be a JSON object")
+    if doc.get("schema") != INCIDENT_SCHEMA:
+        _fail("schema", f"must be {INCIDENT_SCHEMA}, got {doc.get('schema')!r}")
+    if doc.get("kind") not in ("anomaly", "crash"):
+        _fail("kind", f"must be 'anomaly' or 'crash', got {doc.get('kind')!r}")
+    anomalies = doc.get("anomalies")
+    if not isinstance(anomalies, list) or not all(
+        isinstance(a, str) for a in anomalies
+    ):
+        _fail("anomalies", f"must be a list of strings, got {anomalies!r}")
+    for field in ("nloop", "round", "window"):
+        v = doc.get(field)
+        if not isinstance(v, int) or isinstance(v, bool):
+            _fail(field, f"must be an int, got {v!r}")
+        if v < 0:
+            _fail(field, f"must be >= 0, got {v}")
+    if doc["window"] < 1:
+        _fail("window", f"must be >= 1, got {doc['window']}")
+    g = doc.get("group")
+    if g is not None and (not isinstance(g, int) or isinstance(g, bool)):
+        _fail("group", f"must be an int or null, got {g!r}")
+    if not isinstance(doc.get("tag"), str):
+        _fail("tag", f"must be a string, got {doc.get('tag')!r}")
+    rounds = doc.get("rounds")
+    if not isinstance(rounds, list):
+        _fail("rounds", f"must be a list, got {type(rounds).__name__}")
+    if len(rounds) > doc["window"]:
+        _fail(
+            "rounds",
+            f"holds {len(rounds)} rounds but the window is {doc['window']}",
+        )
+    for i, bucket in enumerate(rounds):
+        if not isinstance(bucket, dict) or not isinstance(
+            bucket.get("records"), list
+        ):
+            _fail(f"rounds[{i}]", "must be an object with a 'records' list")
+        for j, rec in enumerate(bucket["records"]):
+            if not isinstance(rec, dict) or "series" not in rec:
+                _fail(
+                    f"rounds[{i}].records[{j}]",
+                    "must be a record object with a 'series' key",
+                )
+    if doc["kind"] == "crash" and not isinstance(
+        doc.get("partial_round"), list
+    ):
+        _fail("partial_round", "must be a list (crash bundles carry the "
+              "open round's records)")
+
+
+class FlightRecorder:
+    """Bounded ring over the streamed records + incident-bundle writer.
+
+    Wired as a metric SINK (utils/metrics.py `MetricsRecorder.sinks`) so
+    it sees exactly the resolved records — and order — the JSONL sink
+    persists (see module docstring). Lifecycle mirrors `JsonlSink`:
+    construct, `open(resume_nloops=...)` (stale-bundle cleanup), then
+    `record`/`flush`/`commit`/`close` from the recorder; the trainer
+    calls `incident()` at anomalous round boundaries and `crash_dump()`
+    from `Trainer.close()` when a run dies mid-flight.
+    """
+
+    def __init__(self, window: int, dir: str, tag: str = ""):
+        if window < 1:
+            raise ValueError(f"flight window must be >= 1, got {window}")
+        self.window = int(window)
+        self.dir = os.path.abspath(dir)
+        self.tag = tag
+        self._ring: collections.deque = collections.deque(maxlen=self.window)
+        self._open: List[dict] = []
+        # rising-edge state: the previous / current round's anomaly sets,
+        # derived purely from the health records passing through record()
+        # — a resumed recorder replays them and re-decides identically
+        self._anom_prev: set = set()
+        self._anom_cur: set = set()
+        self._dumped = 0
+        self._crash_dumped = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def open(self, resume_nloops: Optional[int] = None) -> None:
+        """Create the incidents directory and clear stale bundles: ALL of
+        them for a fresh stream, those at or past the restore loop for a
+        resume (their rounds re-run and re-dump identically — the
+        stream-truncation rule applied to bundle files; the crashed
+        process's crash dump goes with them)."""
+        os.makedirs(self.dir, exist_ok=True)
+        for fname in os.listdir(self.dir):
+            m = _BUNDLE_RE.match(fname)
+            if m is None:
+                continue
+            if resume_nloops is None or int(m.group(1)) >= int(resume_nloops):
+                os.remove(os.path.join(self.dir, fname))
+
+    # -------------------------------------------------------- sink protocol
+
+    def record(self, name: str, rec: dict) -> None:
+        self._open.append({"series": name, **rec})
+        if name == "health":
+            v = rec.get("value")
+            if isinstance(v, dict):
+                self._anom_prev = self._anom_cur
+                self._anom_cur = set(v.get("anomalies", ()))
+        if name == BOUNDARY_SERIES:
+            self._ring.append(
+                {
+                    "nloop": rec.get("nloop"),
+                    "group": rec.get("group"),
+                    "records": self._open,
+                }
+            )
+            self._open = []
+
+    def flush(self) -> None:
+        pass
+
+    def commit(self, nloop: int) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def replay(self, records: Iterable[Tuple[str, dict]]) -> None:
+        """Rebuild ring + edge state from a resumed stream's replayed
+        records (obs/sinks.py `open(resume_nloops=...)` output) — the
+        same `record()` path the live sink feed takes, so the resumed
+        ring equals the crashed process's at the restore point."""
+        for name, rec in records:
+            self.record(name, rec)
+
+    # ------------------------------------------------------------- contents
+
+    def rounds(self) -> List[dict]:
+        """The ring's closed buckets, oldest first (≤ `window`)."""
+        return list(self._ring)
+
+    def partial(self) -> List[dict]:
+        """The open bucket: records streamed since the last boundary —
+        what a crash dump captures of the dying round."""
+        return list(self._open)
+
+    # ---------------------------------------------------------------- dumps
+
+    def _write(self, fname: str, doc: dict) -> str:
+        path = os.path.join(self.dir, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=jsonable, sort_keys=True, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def _base(self, kind: str, anomalies, nloop, group, round_ix) -> dict:
+        return {
+            "schema": INCIDENT_SCHEMA,
+            "kind": kind,
+            "anomalies": [str(a) for a in anomalies],
+            "nloop": int(nloop),
+            "group": int(group) if group is not None else None,
+            "round": int(round_ix),
+            "tag": self.tag,
+            "window": self.window,
+            "rounds": self.rounds(),
+        }
+
+    def incident(
+        self, anomalies, *, nloop: int, group: int, round_ix: int,
+        extra=None,
+    ) -> Optional[str]:
+        """Dump an anomaly bundle for the just-closed round; returns the
+        bundle path, or None when deduped (no anomaly kind the previous
+        round lacked — a chronic alert dumps once, on its rising edge)
+        or over the per-process `MAX_INCIDENTS` budget. `extra` may be a
+        dict or a zero-arg callable returning one — a callable is only
+        evaluated when the bundle actually dumps, so a chronic-anomaly
+        run does not rebuild the (plan-slice, memos) extras every
+        round just to throw them away."""
+        if not set(anomalies) - self._anom_prev:
+            return None
+        if self._dumped >= MAX_INCIDENTS:
+            return None
+        self._dumped += 1
+        doc = self._base("anomaly", anomalies, nloop, group, round_ix)
+        if callable(extra):
+            extra = extra()
+        doc.update(extra or {})
+        return self._write(
+            f"incident-{int(nloop)}-{int(round_ix)}.json", doc
+        )
+
+    def crash_dump(
+        self, *, nloop: int, round_ix: int, extra=None
+    ) -> Optional[str]:
+        """Dump the crash bundle (once): the ring plus the dying round's
+        open bucket. Called from `Trainer.close()` when a started run
+        never completed — an injected chaos crash included. `extra` as
+        in `incident()`."""
+        if self._crash_dumped:
+            return None
+        self._crash_dumped = True
+        doc = self._base("crash", [], nloop, None, round_ix)
+        doc["partial_round"] = self.partial()
+        if callable(extra):
+            extra = extra()
+        doc.update(extra or {})
+        return self._write(
+            f"incident-{int(nloop)}-{int(round_ix)}.json", doc
+        )

@@ -22,7 +22,9 @@ Two kinds of state, both bounded:
   observations, detected faults, rollbacks, quarantined clients,
   deadline misses, exchanges) kept for the last `window` completed
   rounds, yielding rates plus loss-explosion / loss-plateau detection
-  against the windowed per-round mean-loss history.
+  against the windowed per-round mean-loss history and quarantine-burst
+  / deadline-miss-spike detection against the windowed counter means
+  (the flight recorder's full trigger set, obs/flight.py).
 
 Crash-safety rides the usual resume-stream-identity contract
 (docs/OBSERVABILITY.md): the engine is a PURE function of the streamed
@@ -407,6 +409,25 @@ class HealthEngine:
             anomalies.append("nonfinite")
         if cur["rollbacks"]:
             anomalies.append("rollback")
+        # burst/spike detection (the flight recorder's trigger set,
+        # obs/flight.py): a round whose quarantine or deadline-miss
+        # count at least doubles the windowed mean — with a floor of 2,
+        # so a single flagged client never pages — is an incident; a
+        # CHRONIC rate (every round missing the same 2) stops alerting
+        # once the window has absorbed it. Pure in the record sequence.
+        prev = list(self._win)
+
+        def _spike(key: str) -> bool:
+            n = cur[key]
+            if n < 2:
+                return False
+            base = sum(r[key] for r in prev) / len(prev) if prev else 0.0
+            return n > 2.0 * base
+
+        if _spike("quarantined"):
+            anomalies.append("quarantine_burst")
+        if _spike("deadline_missed"):
+            anomalies.append("deadline_miss_spike")
         if mean_loss is not None and prev_means:
             med = _median(prev_means)
             if med > 0 and mean_loss > self.explode_factor * med:

@@ -301,6 +301,51 @@ class RunRegistry:
             )
         return frontier
 
+    def incidents(self) -> dict:
+        """The cross-run incident table (`report --incidents`): every
+        flight-recorder bundle under each ingested stream's
+        `<stream>.incidents/` directory (obs/flight.py), schema-
+        validated — an invalid bundle is skipped with a warning, the
+        refused-stream rule applied to forensics. Rows carry only
+        content-derived fields (kinds, triggering round, bundle
+        basename, record counts) — no wall-clock, no tag — so a
+        crashed+resumed twin directory tables byte-identically."""
+        from federated_pytorch_test_tpu.obs.flight import (
+            list_incidents,
+            validate_incident,
+        )
+
+        rows = []
+        for name, run in sorted(self.runs.items()):
+            for fname, bundle in list_incidents(run.path):
+                if bundle is None:
+                    warnings.warn(
+                        f"{run.path}: unreadable incident bundle {fname}"
+                    )
+                    continue
+                try:
+                    validate_incident(bundle)
+                except ValueError as e:
+                    warnings.warn(
+                        f"{run.path}: invalid incident bundle {fname}: {e}"
+                    )
+                    continue
+                rows.append(
+                    {
+                        "run": name,
+                        "bundle": fname,
+                        "kind": bundle["kind"],
+                        "anomalies": list(bundle["anomalies"]),
+                        "nloop": bundle["nloop"],
+                        "round": bundle["round"],
+                        "rounds_held": len(bundle["rounds"]),
+                        "records": sum(
+                            len(b["records"]) for b in bundle["rounds"]
+                        ),
+                    }
+                )
+        return {"count": len(rows), "bundles": rows}
+
     def report(self) -> dict:
         """The full cross-run document: per-run summaries + curves,
         round-aligned comparison series, the convergence-vs-bytes
@@ -444,6 +489,27 @@ def render_markdown(doc: dict) -> str:
             "`*` = on the frontier: no other run reached at least this "
             "accuracy in at most this simulated round wall."
         )
+    if doc.get("incidents") is not None:
+        inc = doc["incidents"]
+        lines += ["", "## Incidents", ""]
+        if not inc["bundles"]:
+            lines.append(
+                "No incident bundles under the ingested streams' "
+                "`.incidents/` directories."
+            )
+        else:
+            lines.append(
+                "| run | bundle | kind | anomalies | nloop | round "
+                "| rounds held | records |"
+            )
+            lines.append("|---|---|---|---|---|---|---|---|")
+            for r in inc["bundles"]:
+                an = ",".join(r["anomalies"]) or "-"
+                lines.append(
+                    f"| {r['run']} | {r['bundle']} | {r['kind']} | {an} "
+                    f"| {r['nloop']} | {r['round']} | {r['rounds_held']} "
+                    f"| {r['records']} |"
+                )
     lines.append("")
     return "\n".join(lines)
 
@@ -474,6 +540,12 @@ def report_main(argv=None) -> int:
     ap.add_argument("--json", default=None, help="write the JSON report here")
     ap.add_argument("--md", default=None, help="write the markdown here")
     ap.add_argument(
+        "--incidents",
+        action="store_true",
+        help="add the cross-run incident-bundle table (flight-recorder "
+        "bundles under each stream's .incidents/ dir, obs/flight.py)",
+    )
+    ap.add_argument(
         "--quiet", action="store_true", help="suppress the stdout markdown"
     )
     args = ap.parse_args(argv)
@@ -487,6 +559,8 @@ def report_main(argv=None) -> int:
         )
         return 1
     doc = reg.report()
+    if args.incidents:
+        doc["incidents"] = reg.incidents()
     md = render_markdown(doc)
     if args.json:
         with open(args.json, "w") as f:

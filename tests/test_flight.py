@@ -1,0 +1,499 @@
+"""Flight recorder + memory/profiler telemetry tests (obs/flight.py,
+obs/memory.py, obs/console.py — docs/OBSERVABILITY.md).
+
+Smoke tier: ring-buffer bounds and the one segmentation rule
+(`dispatch_count` closes a bucket), incident rising-edge dedupe +
+budget, strict bundle-schema validation naming the field, the
+`--flight-window`/`--profile-budget` config validation satellites, and
+the memory readers' graceful-None contract.
+
+Middle (default) tier: the trainer-level contracts — an anomalous run
+dumps a bundle whose in-bundle series match the stream's last W rounds
+EXACTLY (the acceptance criterion: the ring is a sink, mirroring what
+the JSONL file persists), the `memory`/`incident` series stay OUT of
+the stream (process facts — crash+resume twin identity untouched), the
+folded dispatch stays `{round: 1, round_init: 1}` with all three
+pillars on, the anomaly-armed profiler captures within budget, `watch
+--once` renders the run directory, the analysis-only knobs stay out of
+the stream tag, and incident determinism on resume: a crashed+resumed
+run's bundles equal the uninterrupted twin's (modulo wall-clock/tag/
+memory — the stream normalizer's rules), with the dying process's
+crash bundle cleaned up at the restore point like the truncated stream
+tail it describes.
+"""
+
+import copy
+import glob
+import json
+import os
+
+import pytest
+
+from federated_pytorch_test_tpu.obs import (
+    MAX_INCIDENTS,
+    FlightRecorder,
+    validate_incident,
+)
+
+smoke = pytest.mark.smoke
+
+
+# ------------------------------------------------------- ring mechanics
+
+
+def _round_records(fl, r, *, anomalies=None, group=2):
+    """Feed one synthetic round through the sink protocol; returns the
+    round's stream-line dicts (what the bucket must hold)."""
+    recs = [
+        {"series": "train_loss", "t": 0.1 * r, "value": [float(r)],
+         "nloop": r, "group": group},
+    ]
+    if anomalies is not None:
+        recs.append(
+            {"series": "health", "t": 0.1 * r,
+             "value": {"round": r, "anomalies": list(anomalies),
+                       "window": {}},
+             "nloop": r, "group": group}
+        )
+    recs.append(
+        {"series": "dispatch_count", "t": 0.1 * r,
+         "value": {"round": 1, "round_init": 1, "total": 2},
+         "nloop": r, "group": group}
+    )
+    for d in recs:
+        d = dict(d)
+        fl.record(d.pop("series"), d)
+    return recs
+
+
+@smoke
+def test_flight_ring_keeps_last_window_rounds(tmp_path):
+    fl = FlightRecorder(window=3, dir=str(tmp_path / "inc"), tag="t")
+    fl.open()
+    expected = {}
+    for r in range(7):
+        expected[r] = _round_records(fl, r)
+    rounds = fl.rounds()
+    assert len(rounds) == 3  # bounded: only the last W closed rounds
+    assert [b["nloop"] for b in rounds] == [4, 5, 6]
+    assert [b["group"] for b in rounds] == [2, 2, 2]
+    assert rounds[-1]["records"] == expected[6]
+    # a boundary leaves the open bucket empty; a mid-round record lands
+    # in it (what a crash dump captures of the dying round)
+    assert fl.partial() == []
+    fl.record("train_loss", {"t": 9.9, "value": [7.0], "nloop": 7})
+    assert [d["series"] for d in fl.partial()] == ["train_loss"]
+    with pytest.raises(ValueError):
+        FlightRecorder(window=0, dir=str(tmp_path / "x"))
+
+
+@smoke
+def test_incident_rising_edge_dedupe_and_budget(tmp_path):
+    fl = FlightRecorder(window=2, dir=str(tmp_path / "inc"), tag="tag")
+    fl.open()
+
+    def round_(r, anomalies):
+        _round_records(fl, r, anomalies=anomalies)
+        if anomalies:
+            return fl.incident(
+                anomalies, nloop=r, group=2, round_ix=r, extra={}
+            )
+        return None
+
+    assert round_(0, []) is None
+    p1 = round_(1, ["loss_plateau"])
+    assert p1 is not None and os.path.exists(p1)
+    # chronic: the SAME kind next round dumps nothing
+    assert round_(2, ["loss_plateau"]) is None
+    # a NEW kind alongside the chronic one is a fresh incident
+    p3 = round_(3, ["loss_plateau", "rollback"])
+    assert p3 is not None
+    doc = json.load(open(p3))
+    validate_incident(doc)
+    assert doc["kind"] == "anomaly"
+    assert doc["anomalies"] == ["loss_plateau", "rollback"]
+    assert doc["tag"] == "tag"
+    assert len(doc["rounds"]) == 2  # ring bound, not run length
+    # budget: a pathological every-round-new-kind run caps out
+    fl2 = FlightRecorder(window=1, dir=str(tmp_path / "inc2"))
+    fl2.open()
+    dumped = 0
+    for r in range(MAX_INCIDENTS + 5):
+        _round_records(fl2, r, anomalies=[f"kind{r}"])
+        if fl2.incident([f"kind{r}"], nloop=r, group=0, round_ix=r):
+            dumped += 1
+    assert dumped == MAX_INCIDENTS
+    # the crash dump fires once, bypassing the edge rule
+    assert fl2.crash_dump(nloop=99, round_ix=99) is not None
+    assert fl2.crash_dump(nloop=99, round_ix=99) is None
+
+
+@smoke
+def test_flight_replay_rebuilds_ring_and_edge_state(tmp_path):
+    """The resume mechanism: a recorder fed a stream's replayed records
+    (JSON round-tripped, like obs/sinks.py hands them over) holds the
+    identical ring and re-decides the rising edge identically."""
+    live = FlightRecorder(window=2, dir=str(tmp_path / "a"), tag="t")
+    live.open()
+    stream = []
+    for r in range(4):
+        stream.extend(
+            _round_records(live, r, anomalies=["rollback"] if r >= 2 else [])
+        )
+    resumed = FlightRecorder(window=2, dir=str(tmp_path / "b"), tag="t")
+    resumed.open()
+    resumed.replay(
+        (d["series"], {k: v for k, v in d.items() if k != "series"})
+        for d in (json.loads(json.dumps(x)) for x in stream)
+    )
+    assert resumed.rounds() == live.rounds()
+    # round 3's chronic rollback must dedupe on BOTH (edge state replayed)
+    assert live.incident(["rollback"], nloop=3, group=2, round_ix=3) is None
+    assert (
+        resumed.incident(["rollback"], nloop=3, group=2, round_ix=3) is None
+    )
+
+
+@smoke
+def test_incident_schema_validation_names_the_field(tmp_path):
+    good = {
+        "schema": 1, "kind": "anomaly", "anomalies": ["rollback"],
+        "nloop": 0, "group": 2, "round": 0, "tag": "x", "window": 4,
+        "rounds": [{"nloop": 0, "group": 2,
+                    "records": [{"series": "train_loss", "value": [1.0]}]}],
+    }
+    validate_incident(good)
+    for field, bad_value in (
+        ("schema", 99),
+        ("kind", "meltdown"),
+        ("anomalies", "rollback"),
+        ("nloop", -1),
+        ("round", True),
+        ("window", 0),
+        ("tag", None),
+        ("group", "g"),
+        ("rounds", {}),
+    ):
+        with pytest.raises(ValueError, match=field):
+            validate_incident({**good, field: bad_value})
+    with pytest.raises(ValueError, match="rounds"):
+        validate_incident({**good, "rounds": [good["rounds"][0]] * 9})
+    with pytest.raises(ValueError, match="partial_round"):
+        validate_incident({**good, "kind": "crash"})
+    validate_incident({**good, "kind": "crash", "partial_round": []})
+
+
+@smoke
+def test_flight_and_profiler_config_validation_names_the_field():
+    from federated_pytorch_test_tpu.engine import get_preset
+
+    with pytest.raises(ValueError, match="flight_window"):
+        get_preset("fedavg", flight_window=0)
+    with pytest.raises(ValueError, match="flight_window"):
+        get_preset("fedavg", flight_window=True)
+    with pytest.raises(ValueError, match="flight_window"):
+        get_preset("fedavg", flight_window=2.5)
+    with pytest.raises(ValueError, match="profile_budget"):
+        get_preset("fedavg", profile_budget=0)
+    with pytest.raises(ValueError, match="profile_budget"):
+        get_preset("fedavg", profile_budget=True)
+    # a budget without the trigger directory is a mistake, not a no-op
+    with pytest.raises(ValueError, match="profile_budget"):
+        get_preset("fedavg", profile_budget=5)
+    get_preset("fedavg", profile_on_anomaly="/tmp/p", profile_budget=5)
+    # the two jax.profiler windows cannot nest
+    with pytest.raises(ValueError, match="profile_on_anomaly"):
+        get_preset("fedavg", profile_on_anomaly="/tmp/p", profile_dir="/tmp/q")
+    # captures are armed by health anomalies: without the monitor the
+    # knob could never fire — a config mistake, not a no-op
+    with pytest.raises(ValueError, match="profile_on_anomaly"):
+        get_preset(
+            "fedavg", profile_on_anomaly="/tmp/p", health_monitor=False
+        )
+
+
+@smoke
+def test_memory_readers_graceful_and_sane():
+    from federated_pytorch_test_tpu.obs import (
+        host_rss_bytes,
+        host_rss_peak_bytes,
+        memory_record,
+    )
+
+    rec = memory_record()
+    assert set(rec) == {"rss_bytes", "peak_rss_bytes", "devices"}
+    # this host is Linux: /proc gives real numbers, peak >= current
+    rss, peak = host_rss_bytes(), host_rss_peak_bytes()
+    if rss is not None and peak is not None:
+        assert 0 < rss <= peak
+    # devices: one entry per addressable device, dict or graceful None
+    assert len(rec["devices"]) >= 1
+    assert all(d is None or isinstance(d, dict) for d in rec["devices"])
+    json.dumps(rec)  # the record must be stream-serializable as-is
+
+
+# ----------------------------------- Trainer integration (middle tier)
+# Unmarked: tier-1 over the same tiny model/config family as
+# tests/test_health.py so the persistent compile cache amortizes them.
+
+
+@pytest.fixture(scope="module")
+def _src():
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+
+    return synthetic_cifar(n_train=240, n_test=60)
+
+
+def _tiny(**over):
+    from federated_pytorch_test_tpu.engine import get_preset
+
+    base = dict(
+        batch=40, nloop=2, nadmm=2, max_groups=1, model="net",
+        check_results=False, synthetic_ok=True,
+    )
+    base.update(over)
+    return get_preset("fedavg", **base)
+
+
+@pytest.fixture(scope="module")
+def incident_run(_src, tmp_path_factory):
+    """One anomalous run with all three pillars on: nan_burst corruption
+    under the mean combiner + rollback mode → every round rolls back →
+    the health engine fires (nonfinite + rollback) → one incident
+    bundle (rising edge), one profiler capture (budget 1).
+
+    `jax.profiler.trace` is STUBBED here: a real CPU capture costs ~90 s
+    of profiler post-processing — the arming/budget/record logic is what
+    the tier-1 gate covers, and the tier-2 incident_smoke (scripts/
+    ci.sh) performs one real capture through the CLI."""
+    import contextlib
+    from unittest import mock
+
+    import jax
+
+    from federated_pytorch_test_tpu.engine import Trainer
+
+    tmp = tmp_path_factory.mktemp("flight")
+    cfg = _tiny(
+        metrics_stream=str(tmp / "m.jsonl"),
+        fault_plan="seed=5,corrupt=1:nan_burst",
+        fault_mode="rollback",
+        flight_window=4,
+        profile_on_anomaly=str(tmp / "prof"),
+        profile_budget=1,
+    )
+    profiled = []
+
+    @contextlib.contextmanager
+    def fake_trace(log_dir):
+        profiled.append(log_dir)
+        yield
+
+    with mock.patch.object(jax.profiler, "trace", fake_trace):
+        tr = Trainer(cfg, verbose=False, source=_src)
+        tr.run()
+    return tr, cfg, tmp, profiled
+
+
+def _stream_rounds(path):
+    """Segment a JSONL stream into rounds on `dispatch_count` — the
+    flight ring's one boundary rule."""
+    rounds, cur = [], []
+    for line in open(path):
+        rec = json.loads(line)
+        if "series" not in rec:
+            continue
+        cur.append(rec)
+        if rec["series"] == "dispatch_count":
+            rounds.append(cur)
+            cur = []
+    return rounds
+
+
+def test_incident_bundle_matches_stream_last_w_rounds(incident_run):
+    tr, cfg, tmp, _ = incident_run
+    bundles = sorted(glob.glob(str(tmp / "m.jsonl.incidents" / "*.json")))
+    assert len(bundles) == 1  # chronic anomaly: one rising-edge dump
+    doc = json.load(open(bundles[0]))
+    validate_incident(doc)
+    assert set(doc["anomalies"]) >= {"nonfinite", "rollback"}
+    assert doc["tag"] == tr._stream_tag()
+    # THE acceptance criterion: in-bundle series == the stream's last W
+    # rounds EXACTLY (raw record dicts, wall-clock fields included — the
+    # ring is a sink mirroring the very lines the file holds)
+    rounds = _stream_rounds(tmp / "m.jsonl")
+    held = rounds[: doc["round"] + 1][-doc["window"]:]
+    assert [b["records"] for b in doc["rounds"]] == held
+    # the bundle is self-contained: plan slice names the corruption
+    # victims, the memory snapshot rides along
+    assert doc["fault_plan"]["slice"], doc["fault_plan"]
+    assert doc["memory"] is not None
+    # the recorder's own incident record points at the bundle
+    inc = tr.recorder.series["incident"]
+    assert len(inc) == 1
+    assert inc[0]["value"]["bundle"] == os.path.basename(bundles[0])
+
+
+def test_memory_and_incident_series_stay_out_of_the_stream(incident_run):
+    """The stream=False exclusion satellite: memory numbers and incident
+    pointers are process facts — present in the in-memory store, absent
+    from the JSONL stream, so the crash+resume twin-identity gates
+    (tests/test_obs.py) hold with both pillars on by default."""
+    tr, cfg, tmp, _ = incident_run
+    mem = tr.recorder.series["memory"]
+    assert len(mem) == cfg.nloop  # one record per partition round
+    v = mem[-1]["value"]
+    assert v["rss_bytes"] is None or v["rss_bytes"] > 0
+    streamed = {
+        json.loads(line).get("series") for line in open(tmp / "m.jsonl")
+    }
+    assert "memory" not in streamed
+    assert "incident" not in streamed
+    assert "profile_capture" not in streamed
+    assert "health" in streamed  # the trigger series IS streamed
+
+
+def test_folded_dispatch_budget_with_all_pillars_on(incident_run):
+    """The acceptance dispatch gate: flight ring, memory telemetry, and
+    the armed profiler consume already-recorded host data — the folded
+    round still dispatches exactly {round, round_init}."""
+    tr, _, _, _ = incident_run
+    for rec in tr.recorder.series["dispatch_count"]:
+        assert rec["value"] == {"round": 1, "round_init": 1, "total": 2}
+
+
+def test_profiler_armed_and_captured_within_budget(incident_run):
+    tr, cfg, tmp, profiled = incident_run
+    caps = tr.recorder.series["profile_capture"]
+    # anomalies fire every round; budget 1 → exactly one capture, taken
+    # the round AFTER the first alert (the stubbed window was entered
+    # exactly once — the real-capture leg is tier-2 incident_smoke)
+    assert len(caps) == len(profiled) == 1
+    assert caps[0]["nloop"] == 1
+    assert caps[0]["value"]["dir"] == profiled[0]
+    assert os.path.isdir(caps[0]["value"]["dir"])
+
+
+def test_watch_once_renders_the_run_dir(incident_run, capsys):
+    from federated_pytorch_test_tpu.obs.console import watch_main
+
+    _, _, tmp, _ = incident_run
+    # a parseable-but-foreign bundle beside the real one must degrade to
+    # a label, never crash the dashboard
+    foreign = tmp / "m.jsonl.incidents" / "incident-9-9.json"
+    foreign.write_text('{"what": "not an incident"}')
+    try:
+        assert watch_main([str(tmp), "--once"]) == 0
+        out = capsys.readouterr().out
+    finally:
+        os.remove(foreign)
+    assert "m  [fedavg:seed0]" in out
+    assert "(completed)" in out  # the sidecar's terminal-state stamp
+    assert "incident-0-0.json" in out
+    assert "incident-9-9.json[?]" in out
+    assert "health 2 rounds monitored" in out
+
+
+def test_flight_knobs_excluded_from_stream_tag(incident_run):
+    """Analysis-only knobs splice (the health-knob rule): the tag digest
+    reads only (cfg, injector), so a shallow copy with a swapped cfg
+    probes it without paying another Trainer build."""
+    tr, cfg, _, _ = incident_run
+    tag = tr._stream_tag()
+    probe = copy.copy(tr)
+    probe.cfg = cfg.replace(
+        flight_recorder=False, flight_window=16, memory_telemetry=False,
+        profile_on_anomaly=None, profile_budget=3,
+    )
+    assert probe._stream_tag() == tag
+    probe.cfg = cfg.replace(nadmm=3)  # a real knob still refuses
+    assert probe._stream_tag() != tag
+
+
+@pytest.mark.slow
+def test_incident_determinism_on_resume(_src, tmp_path):
+    """Crashed+resumed bundles equal the uninterrupted twin's modulo
+    wall-clock fields, the tag, and the memory snapshot (process facts
+    — the stream normalizer's exclusions); the dying process's crash
+    bundle is cleaned up at the restore point like the truncated
+    stream tail it describes.
+
+    Slow tier (3 trainer runs ≈ 17 s — the tier-1 wall sits at the
+    870 s gate's edge, the PR-9 re-tiering rule): tier-1 keeps the
+    bundle==stream acceptance and the smoke-tier replay/edge-state
+    mechanics; the end-to-end crash leg also rides the driver-level
+    chaos smokes."""
+    from federated_pytorch_test_tpu.engine import Trainer
+    from federated_pytorch_test_tpu.fault import InjectedCrash
+
+    common = dict(
+        fault_mode="rollback", save_model=True, resume="auto",
+        flight_window=4,
+    )
+    plan = "seed=5,corrupt=1:nan_burst"
+    cfg = _tiny(
+        metrics_stream=str(tmp_path / "a.jsonl"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        fault_plan=plan + ",crash=1:2:0",
+        **common,
+    )
+    tr = Trainer(cfg, verbose=False, source=_src)
+    with pytest.raises(InjectedCrash):
+        tr.run()
+    tr.close()
+
+    def bundles(stream):
+        out = {}
+        for p in glob.glob(str(stream) + ".incidents/*.json"):
+            out[os.path.basename(p)] = json.load(open(p))
+        return out
+
+    crashed = bundles(tmp_path / "a.jsonl")
+    # the dying process dumped its crash bundle beside the anomaly one
+    assert {d["kind"] for d in crashed.values()} == {"anomaly", "crash"}
+
+    tr2 = Trainer(cfg, verbose=False, source=_src)
+    tr2.run()
+    tr2.close()
+    twin_cfg = _tiny(
+        metrics_stream=str(tmp_path / "b.jsonl"),
+        checkpoint_dir=str(tmp_path / "ckpt_twin"),
+        fault_plan=plan,
+        **common,
+    )
+    tw = Trainer(twin_cfg, verbose=False, source=_src)
+    tw.run()
+    tw.close()
+
+    def norm(doc):
+        doc = dict(doc)
+        doc.pop("tag", None)
+        doc.pop("memory", None)  # RSS is a process fact
+        fp = doc.get("fault_plan")
+        if fp:
+            # the twins' plans legitimately differ by the crash point
+            fp = {k: v for k, v in fp.items() if k == "slice"}
+            doc["fault_plan"] = fp
+
+        def scrub(rec):
+            rec = {k: v for k, v in rec.items() if k != "t"}
+            if rec.get("series") == "step_time" and isinstance(
+                rec.get("value"), dict
+            ):
+                rec["value"] = {
+                    k: v for k, v in rec["value"].items() if k != "seconds"
+                }
+            return rec
+
+        doc["rounds"] = [
+            {**b, "records": [scrub(r) for r in b["records"]]}
+            for b in doc["rounds"]
+        ]
+        return doc
+
+    resumed = {k: norm(v) for k, v in bundles(tmp_path / "a.jsonl").items()}
+    twin = {k: norm(v) for k, v in bundles(tmp_path / "b.jsonl").items()}
+    # resume deleted the stale crash bundle (its loop re-ran); what
+    # remains is the identical incident set, bundle for bundle
+    assert all(d["kind"] == "anomaly" for d in resumed.values())
+    assert resumed == twin

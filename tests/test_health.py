@@ -132,7 +132,9 @@ def test_engine_counters_sketches_and_window_rates():
             ],
         ),
     )
-    assert anomalies == ["nonfinite"]
+    # 2 deadline-missing clients against an empty window is a spike
+    # (the flight recorder's trigger set — obs/flight.py)
+    assert anomalies == ["nonfinite", "deadline_miss_spike"]
     w = val["window"]
     assert w["rounds"] == 1
     # 1 NaN loss entry + 1 null norm = 2 non-finite observations
@@ -157,6 +159,27 @@ def test_engine_loss_explosion_rollback_and_plateau():
     # 100x the windowed median: explosion
     _, an = _run_round(eng, _round_records([100.0, 100.0]))
     assert "loss_explosion" in an
+    # a 3-client quarantine against a quiet window is a burst; the SAME
+    # chronic count the next rounds is absorbed by the window and stops
+    # alerting (spike semantics, not a rate alarm)
+    burst = HealthEngine(window=3)
+    _, an = _run_round(burst, _round_records([1.0, 1.0]))
+    assert an == []
+    q = [("quarantine", {"t": 0.0, "value": {"clients": [0, 1, 2]}})]
+    _, an = _run_round(burst, _round_records([1.0, 1.0], extra=q))
+    assert an == ["quarantine_burst"]
+    _, an = _run_round(burst, _round_records([1.0, 1.0], extra=q))
+    assert "quarantine_burst" not in an
+    # a single flagged client never pages (floor of 2)
+    solo = HealthEngine(window=3)
+    _, an = _run_round(
+        solo,
+        _round_records(
+            [1.0, 1.0],
+            extra=[("quarantine", {"t": 0.0, "value": {"clients": [2]}})],
+        ),
+    )
+    assert an == []
     # a rollback fault flags the round
     _, an = _run_round(
         eng,
