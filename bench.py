@@ -618,6 +618,106 @@ def _cohort_probe():
     }
 
 
+def _prefetch_probe():
+    """Warm outer-loop wall with the pipelined cohort prefetch on vs
+    off at N=10k/C=8, plus the spilled store's residency evidence.
+
+    The prefetch claim (clients/prefetch.py, docs/SCALE.md §Prefetch
+    lifecycle) is that the cohort gather — store chunk reads, the
+    cohort's data-shard slices, their device puts — leaves the round
+    wall: loop n+1's gather runs on a background thread while loop n
+    trains, and adoption is bit-identical to a cold gather
+    (tests/test_prefetch.py). `prefetch_overlap_saved_s` is the
+    medianized warm gather→rounds→scatter loop wall with prefetch OFF
+    minus ON — approximately the synchronous gather's wall, and > 0
+    whenever the gather overlaps any compute at all (the acceptance
+    gate on the CPU twin). The shard pool is sized so the per-loop
+    data gather is tens of MB — a real gather, not a rounding error.
+
+    The spilled-store rows ride along (the bounded-RSS story,
+    ROADMAP item 4): one short run with `--store-resident-chunks`
+    pinned low reports the post-run resident count and the evictions
+    the budget forced — the fields the `memory_rss_peak_mb` headline
+    needs next to it to mean "flat in N".
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+    from federated_pytorch_test_tpu.obs import TraceRecorder
+
+    c, n_virtual = 8, 10_000
+    src = synthetic_cifar(n_train=c * 40 * 2, n_test=60)
+    base = dict(
+        batch=40, nloop=5, nadmm=1, max_groups=1, model="net",
+        check_results=False, synthetic_ok=True,
+        virtual_clients=n_virtual, cohort=c, data_shards=c,
+    )
+    # the signal lives in the cohort_gather SPAN, not the loop wall: on
+    # the CPU twin the rounds are seconds of host compute while the
+    # gather is milliseconds, so a wall-minus-wall delta is scheduler
+    # noise. The span IS the claim — with prefetch off it is the
+    # synchronous gather sitting on the wall; with prefetch on it is
+    # the adoption cost (patch + bookkeeping), the background thread
+    # having done the gather during the previous loop's rounds.
+    gather_s, walls = {}, {}
+    for on in (True, False):
+        cfg = get_preset("fedavg", prefetch=on, **base)
+        tr = Trainer(cfg, verbose=False, source=src)
+        tr.recorder.tracer = TraceRecorder()
+        tr.run_loop(0)  # warmup: compile-dominated
+        dts = []
+        for nloop in range(1, 5):
+            t0 = time.perf_counter()
+            tr.run_loop(nloop)  # one gather -> rounds -> scatter cycle
+            dts.append(time.perf_counter() - t0)
+        spans = [
+            e["dur"] / 1e6
+            for e in tr.recorder.tracer.events
+            if e.get("name") == "cohort_gather"
+            and e.get("args", {}).get("nloop", 0) >= 1  # warm loops only
+        ]
+        gather_s[on] = float(np.median(spans))
+        walls[on] = float(np.median(dts))
+        tr.close()
+    out = {
+        "virtual_clients": n_virtual,
+        "cohort": c,
+        "loop_time_prefetch_on_s": round(walls[True], 4),
+        "loop_time_prefetch_off_s": round(walls[False], 4),
+        "gather_span_prefetch_on_s": round(gather_s[True], 5),
+        "gather_span_prefetch_off_s": round(gather_s[False], 5),
+        # > 0: the gather span left the critical path (off-mode still
+        # pays it synchronously on the wall; on-mode pays only adoption)
+        "prefetch_overlap_saved_s": round(
+            gather_s[False] - gather_s[True], 5
+        ),
+    }
+    # spilled-store residency: a short bounded run through the real
+    # checkpoint path (eviction spills need the manifest discipline)
+    d = tempfile.mkdtemp(prefix="bench_spill_")
+    try:
+        cfg = get_preset(
+            "fedavg", **{**base, "nloop": 3},
+            store_chunk_clients=8, store_resident_chunks=2,
+            save_model=True, checkpoint_dir=os.path.join(d, "ckpt"),
+        )
+        tr = Trainer(cfg, verbose=False, source=src)
+        tr.run()
+        res = tr.store.residency()
+        out["store_resident_chunks"] = res["resident_chunks"]
+        out["store_resident_budget"] = res["resident_budget"]
+        out["store_evictions"] = res["evictions"]
+        out["store_spill_bytes"] = res["spill_bytes"]
+        tr.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _health_probe():
     """Warm-round wall with the in-run health engine on vs off.
 
@@ -857,6 +957,12 @@ def main() -> None:
     except Exception as e:  # a failed probe must not kill the bench
         out["cohort"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    # ---- the prefetch probe: cohort gather off the round wall ----
+    try:
+        out["prefetch"] = _prefetch_probe()
+    except Exception as e:  # a failed probe must not kill the bench
+        out["prefetch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # ---- the health probe: sketch/monitor overhead per warm round ----
     try:
         out["health"] = _health_probe()
@@ -1075,6 +1181,15 @@ def main() -> None:
     headline["memory_rss_peak_mb"] = out.get("flight", {}).get(
         "memory_rss_peak_mb"
     )
+    # the scale-out facts (pipelined prefetch + spilled store PR,
+    # docs/SCALE.md): warm loop wall the background cohort gather takes
+    # off the critical path (> 0 = the gather span overlapped compute),
+    # and the bounded store's residency evidence riding next to the
+    # peak-RSS row — resident chunks held vs the evictions the budget
+    # forced (the flat-in-N story needs both numbers together)
+    for key in ("prefetch_overlap_saved_s", "store_resident_chunks",
+                "store_evictions"):
+        headline[key] = out.get("prefetch", {}).get(key)
     if "mxu_probe" in out:
         headline["mxu_pct_peak"] = out["mxu_probe"]["pct_peak"]
         headline["mxu_probe_valid"] = out["mxu_probe"]["valid"]

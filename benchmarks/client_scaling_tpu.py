@@ -114,7 +114,7 @@ def _k_sweep(jax, jnp):
     }
 
 
-def _cohort_sweep(jax, ns, cohorts, model, batch, steps):
+def _cohort_sweep(jax, ns, cohorts, model, batch, steps, prefetch=True):
     """Warm gather→round→scatter wall over (cohort C, population N).
 
     Per-CLIENT work is held constant across every row: the shard pool is
@@ -159,7 +159,7 @@ def _cohort_sweep(jax, ns, cohorts, model, batch, steps):
                 "fedavg", model=model, batch=batch, check_results=False,
                 nadmm=1, nepoch=1, max_groups=1, reg_mode="none",
                 virtual_clients=n_virtual, cohort=cohort,
-                data_shards=shards,
+                data_shards=shards, prefetch=prefetch,
             )
             tr = Trainer(cfg, verbose=False, source=src)
             tr.run_loop(0)  # warmup: compile-dominated
@@ -174,6 +174,7 @@ def _cohort_sweep(jax, ns, cohorts, model, batch, steps):
             rows.append({
                 "virtual_clients": n_virtual,
                 "cohort": cohort,
+                "prefetch": bool(prefetch),
                 "n_devices": d,
                 "round_time_s": round(dt, 4),
                 "samples_per_sec": round(sps, 1),
@@ -233,6 +234,12 @@ def main():
         help="run on the CPU mesh twin (no TPU reachable); output gets "
         "a _cpu suffix and the TPU re-measurement stays owed",
     )
+    ap.add_argument(
+        "--no-prefetch", action="store_true",
+        help="disable the pipelined cohort prefetch for the cohort "
+        "sweep (clients/prefetch.py) — measures the synchronous-gather "
+        "wall the prefetch removes; rows record which mode they ran",
+    )
     args = ap.parse_args()
 
     import jax
@@ -249,7 +256,8 @@ def main():
         ns = sorted(int(v) for v in args.virtual_clients.split(","))
         cohorts = sorted(int(v) for v in args.cohort.split(","))
         out = _cohort_sweep(
-            jax, ns, cohorts, args.model, args.batch, args.steps
+            jax, ns, cohorts, args.model, args.batch, args.steps,
+            prefetch=not args.no_prefetch,
         )
         path = os.path.join(here, f"cohort_scaling_tpu{suffix}.json")
     else:

@@ -78,7 +78,13 @@ gathered into the same one-dispatch round program and scattered back,
 with `--data-shards S` mapping the population onto S disjoint data
 shards. Fault schedules stay keyed by virtual-client id, checkpoints
 write only dirty store chunks (O(C) per loop), and crash recovery
-replays the identical cohort sequence.
+replays the identical cohort sequence. The NEXT loop's cohort gather is
+prefetched on a background thread while the current loop trains
+(`--no-prefetch` is the bitwise-identical fallback), and
+`--store-resident-chunks R` LRU-bounds the store chunks held in RAM —
+clean chunks evict and memory-map back in on demand, dirty ones spill
+to the checkpoint dir first — so host RSS is O(R + cohort), flat in N
+(docs/SCALE.md §Spilled store: the million-virtual-client shape).
 
 Observability (obs/, docs/OBSERVABILITY.md) rides it too:
 `--metrics-stream run.jsonl` streams every metric record to a crash-safe
@@ -187,6 +193,24 @@ def _print_summary(recorder, cfg) -> None:
             f"(per-client min={part['min']} max={part['max']} "
             f"mean={part['mean']})"
         )
+    st = recorder.latest("store_summary")
+    if st is not None:
+        # the spilled-store digest (clients/store.py residency): how
+        # bounded the host side actually stayed
+        budget = st.get("resident_budget")
+        line = (
+            f"# store: {st['chunks_materialized']} resident chunk(s)"
+            + (f" (budget {budget})" if budget is not None else "")
+            + f", {st.get('on_disk_chunks', 0)} on disk"
+        )
+        if st.get("evictions"):
+            line += (
+                f"; {st['evictions']} eviction(s), "
+                f"{st.get('spill_bytes', 0):,} B spilled"
+            )
+        if st.get("spill_reads"):
+            line += f", {st['spill_reads']} spill read(s)"
+        print(line)
     inj = recorder.latest("injected_faults")
     if inj is not None:
         # the chaos scoreboard: scheduled kinds come from the pure plan

@@ -7,10 +7,15 @@ of C gathered into the unchanged one-dispatch round program each outer
 loop, survivors scattered back):
 
 * `ClientStore` (store.py) — chunked, lazily-materialized host state
-  with O(C)-per-loop dirty-chunk checkpointing;
+  with O(C)-per-loop dirty-chunk checkpointing and an LRU-bounded
+  resident set (clean-chunk eviction + memory-mapped spill reads, so
+  host RSS is flat in N — docs/SCALE.md §Spilled store);
 * `CohortSampler` (cohort.py) — the participation schedule, pure in
   `(seed, nloop)` like a `fault.FaultPlan`, riding the shared
-  SEED_FOLDS registry.
+  SEED_FOLDS registry;
+* `CohortPrefetcher` (prefetch.py) — double-buffers the next loop's
+  cohort gather on a background thread so store I/O leaves the round
+  wall (`--no-prefetch` is the bitwise fallback).
 
 The engine wires both in `engine/trainer.py` (`--virtual-clients N
 --cohort C`); fault schedules stay keyed by VIRTUAL client id, so a
@@ -21,10 +26,12 @@ from federated_pytorch_test_tpu.clients.cohort import (
     WEIGHTINGS,
     CohortSampler,
 )
+from federated_pytorch_test_tpu.clients.prefetch import CohortPrefetcher
 from federated_pytorch_test_tpu.clients.store import ClientStore
 
 __all__ = [
     "ClientStore",
+    "CohortPrefetcher",
     "CohortSampler",
     "WEIGHTINGS",
 ]

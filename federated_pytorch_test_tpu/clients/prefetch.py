@@ -1,0 +1,136 @@
+"""Pipelined cohort prefetch: loop n+1's gather overlaps loop n's rounds.
+
+Cohort mode's per-loop wall is `gather → rounds → scatter` (docs/
+SCALE.md). The scatter already overlaps device compute (its device→host
+copies are enqueued asynchronously right after the last round's
+dispatch), but the GATHER — store chunk reads, the cohort's data-shard
+slices, and their device_puts — was synchronous host I/O sitting on the
+round wall. This module double-buffers it: while loop n trains, a
+background thread assembles loop n+1's cohort, and `_begin_loop_cohort`
+adopts the finished buffers instead of gathering cold.
+
+**Decision points** (the prefetch lifecycle, docs/SCALE.md §Prefetch
+lifecycle). A gather can only start once the cohort is DECIDED, and the
+decision must read exactly the state the synchronous path would:
+
+* `uniform` / `samples` / `identity` weighting — the draw is pure in
+  `(cohort_seed, nloop)` (clients/cohort.py), so loop n+1's cohort is
+  known the moment loop n begins: the trainer launches at the end of
+  loop n's own gather, and the prefetch overlaps the loop's entire
+  round schedule. Churn availability composes — the pool mask is pure
+  in the fault plan's seed.
+* `telemetry` weighting — the draw reads the store's reliability
+  counters, which loop n updates at scatter time: the decision is
+  pinned at loop n's SCATTER-FINALIZE (the weights' natural
+  availability point), and the launched gather overlaps the loop's
+  commit tail (stream marker, checkpoint write) — still ahead of loop
+  n+1's first dispatch. The early draw lands in the sampler's history
+  exactly where the synchronous draw would (first call of the loop),
+  so `cohort_weight` records and resume replay are unchanged.
+
+**Staleness rule.** A prefetch launched before loop n's scatter reads
+PRE-scatter store rows. Scatter only writes loop n's own cohort, so the
+only rows that can go stale are the overlap `cohort(n) ∩ cohort(n+1)`
+— known at launch. When the overlap is empty (the common case at
+N ≫ C) the worker device_puts everything and adoption is free; when it
+isn't, the worker keeps host arrays and `_begin_loop_cohort` re-gathers
+just the overlap rows after scatter n lands, patches, and puts — the
+adopted values are bit-for-bit what the synchronous gather would have
+produced (`--no-prefetch` is the always-available bitwise fallback,
+tests/test_prefetch.py). Store fields registered DURING loop n (a
+group's first rho/ef scatter) are gathered synchronously at adoption —
+they were unknown at launch. Scatter-before-next-gather ordering is
+therefore preserved *semantically*: the bytes adopted for any row a
+scatter touched are post-scatter bytes.
+
+**Failure rule.** A prefetch is an optimization, never a dependency: a
+worker exception is stashed and adoption falls back to the synchronous
+gather with a warning; a crash mid-prefetch just loses the daemon
+thread with the process, and the resumed run gathers cold — stream and
+store identity are untouched (the crash/resume contract rides the
+unchanged commit ordering).
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class CohortPrefetcher:
+    """One in-flight prefetched cohort, at most.
+
+    `worker(nloop, ids, known_dirty)` runs on the background thread and
+    returns an opaque payload the trainer adopts; the prefetcher itself
+    is deliberately ignorant of jax and the store — it owns only the
+    thread lifecycle and the match-or-discard rule.
+    """
+
+    def __init__(self, worker: Callable[[int, np.ndarray, np.ndarray], Any]):
+        self._worker = worker
+        self._pending: Optional[dict] = None
+
+    @property
+    def in_flight(self) -> Optional[int]:
+        """The loop index of the pending prefetch, or None."""
+        return self._pending["nloop"] if self._pending else None
+
+    def launch(
+        self, nloop: int, ids: np.ndarray, known_dirty: np.ndarray
+    ) -> None:
+        """Start assembling loop `nloop`'s cohort `ids` in the
+        background. `known_dirty` are the virtual ids the CURRENT loop
+        will scatter before adoption — the worker must leave their rows
+        patchable (host-side) or prove the overlap empty. A second
+        launch replaces an untaken pending one (out-of-order benchmark
+        drivers); the superseded thread finishes into the void."""
+        box = {"payload": None, "error": None}
+        ids = np.asarray(ids, np.int64)
+        known_dirty = np.asarray(known_dirty, np.int64)
+
+        def run():
+            try:
+                box["payload"] = self._worker(nloop, ids, known_dirty)
+            except BaseException as e:  # stash; adoption falls back
+                box["error"] = e
+
+        t = threading.Thread(
+            target=run, name=f"cohort-prefetch-{nloop}", daemon=True
+        )
+        self._pending = {"nloop": int(nloop), "ids": ids, "box": box,
+                         "thread": t}
+        t.start()
+
+    def take(self, nloop: int, ids: np.ndarray) -> Optional[Any]:
+        """The finished payload for loop `nloop` with cohort `ids`, or
+        None (nothing pending, a mismatched target, or a failed worker
+        — all of which mean: gather synchronously). Blocks until the
+        in-flight work completes; by adoption time that work has been
+        overlapping the previous loop's rounds, so the wait is at most
+        what the synchronous gather would have cost anyway."""
+        p, self._pending = self._pending, None
+        if p is None:
+            return None
+        if p["nloop"] != int(nloop) or not np.array_equal(
+            p["ids"], np.asarray(ids, np.int64)
+        ):
+            # a replayed/out-of-order loop: the prefetched cohort is not
+            # this one — discard (the thread finishes into the void)
+            return None
+        p["thread"].join()
+        err = p["box"]["error"]
+        if err is not None:
+            warnings.warn(
+                f"cohort prefetch for loop {nloop} failed "
+                f"({type(err).__name__}: {err}); gathering synchronously"
+            )
+            return None
+        return p["box"]["payload"]
+
+    def cancel(self) -> None:
+        """Drop any pending prefetch (end of run / close): the daemon
+        thread finishes into the void and its buffers are released."""
+        self._pending = None

@@ -9,7 +9,7 @@ mostly-idle virtual clients on the HOST, and each round only the sampled
 cohort's rows ever touch a device (clients/cohort.py, engine/trainer.py
 gather → fused round → scatter).
 
-`ClientStore` is that host side. Three properties drive the design:
+`ClientStore` is that host side. Four properties drive the design:
 
 * **Lazy chunks.** Client rows live in fixed-size chunks
   (`chunk_clients` ids per chunk). A chunk is PRISTINE — represented by
@@ -20,6 +20,19 @@ gather → fused round → scatter).
   the clients ever *touched*, not with N: a 1M-client store that has run
   ten C=64 cohorts holds ≤ 640 materialized rows.
 
+* **Spilled residency** (`resident_chunks`, docs/SCALE.md §Spilled
+  store). Even "touched only" grows without bound over a long run, so
+  the RESIDENT set — chunks held in RAM — is LRU-bounded when a budget
+  is set. A CLEAN chunk (its current version is on disk) evicts for
+  free: the dict entry is dropped and later gathers read the needed
+  rows straight off a memory-mapped view of its `.npz` file (rows are
+  copied out; the file is never held open past the call). A DIRTY chunk
+  spills first — written as the next `chunk_<cid>_v<seq>.npz` version
+  through exactly the `save` path, so the following manifest simply
+  references the already-written file. Host RSS is therefore
+  O(resident budget + cohort), flat in N; with no budget the store
+  keeps the legacy keep-everything behavior bit for bit.
+
 * **Dirty-chunk checkpointing.** `save(dir, step)` writes ONLY the
   chunks dirtied since the last save (one `.npz` per chunk, tmp+rename
   like utils/checkpoint.py) plus a small JSON manifest mapping every
@@ -29,6 +42,9 @@ gather → fused round → scatter).
   the previous manifest still references the previous versions. Per-loop
   checkpoint delta is O(C) (tests/test_clients.py asserts it), while a
   naive store-in-the-orbax-tree design would rewrite O(N) every loop.
+  An eviction-spilled version written between saves is the same story:
+  committed only when a manifest names it, orphaned (and GC'd) when the
+  run crashes first — spilling never widens the crash window.
 
 * **Field registry.** A row is a set of named fields — `flat` (the
   client's parameter vector), one per batch-stats leaf, one per
@@ -48,12 +64,25 @@ Static per-client metadata (data-shard assignment, per-shard sample
 counts) is computed once at construction and never checkpointed — it is
 a pure function of (N, n_shards, shard sizes), the same purity contract
 the cohort sampler and fault plans ride.
+
+Thread-safety: the cohort prefetcher (clients/prefetch.py) gathers loop
+n+1's rows on a background thread while the trainer's main thread may
+scatter loop n's, save a checkpoint, or evict under the residency
+budget. One re-entrant lock serializes every public operation — the
+critical sections are O(C) row copies or one chunk's file I/O, so the
+background gather still overlaps all of the round's device compute.
 """
 
 from __future__ import annotations
 
+import ast
+import contextlib
 import json
+import mmap
 import os
+import struct
+import threading
+import zipfile
 from typing import Dict, Optional
 
 import numpy as np
@@ -65,6 +94,65 @@ def _manifest_path(root: str, step: int) -> str:
     return os.path.join(root, f"manifest_step_{step}.json")
 
 
+def _mmap_npz(path: str) -> Dict[str, np.ndarray]:
+    """Read-only array views into an uncompressed `.npz`, one shared mmap.
+
+    `np.load(..., mmap_mode=...)` silently ignores the mode for zip
+    archives (every member would be decompressed into RAM), which is
+    exactly the O(chunk) copy a spilled gather exists to avoid. np.savez
+    STORES members uncompressed, so each `<name>.npy` payload is a
+    contiguous byte range of the file: map the file once, parse each
+    member's local header + npy header, and view the payload in place.
+    A gather then copies only the rows it needs.
+
+    Falls back to a full `np.load` read (same values, more RAM for the
+    duration of the call) on anything unexpected — compressed members,
+    Fortran order, a dtype whose descr isn't a plain string — rather
+    than ever failing a restore over an optimization.
+    """
+    try:
+        with open(path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        out: Dict[str, np.ndarray] = {}
+        with zipfile.ZipFile(path) as zf:
+            for info in zf.infolist():
+                if info.compress_type != zipfile.ZIP_STORED:
+                    raise ValueError("compressed npz member")
+                if not info.filename.endswith(".npy"):
+                    continue
+                ho = info.header_offset
+                # local file header: magic(4) .. name_len@26 extra_len@28
+                if mm[ho : ho + 4] != b"PK\x03\x04":
+                    raise ValueError("unexpected local header")
+                name_len, extra_len = struct.unpack_from("<HH", mm, ho + 26)
+                o = ho + 30 + name_len + extra_len
+                if mm[o : o + 6] != b"\x93NUMPY":
+                    raise ValueError("not an npy member")
+                major = mm[o + 6]
+                if major == 1:
+                    (hlen,) = struct.unpack_from("<H", mm, o + 8)
+                    data = o + 10 + hlen
+                    header = bytes(mm[o + 10 : o + 10 + hlen])
+                else:
+                    (hlen,) = struct.unpack_from("<I", mm, o + 8)
+                    data = o + 12 + hlen
+                    header = bytes(mm[o + 12 : o + 12 + hlen])
+                meta = ast.literal_eval(header.decode("latin1"))
+                if meta.get("fortran_order") or not isinstance(
+                    meta.get("descr"), str
+                ):
+                    raise ValueError("non-C-contiguous or structured npy")
+                dtype = np.dtype(meta["descr"])
+                shape = tuple(meta["shape"])
+                arr = np.ndarray(shape, dtype, buffer=mm, offset=data)
+                arr.flags.writeable = False
+                out[info.filename[:-4]] = arr
+        return out
+    except (OSError, ValueError, KeyError, SyntaxError, struct.error):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+
 class ClientStore:
     """Chunked, lazily-materialized `[N, ...]` per-field client state."""
 
@@ -74,15 +162,35 @@ class ClientStore:
         shard_ids: np.ndarray,
         sample_counts: np.ndarray,
         chunk_clients: int = 256,
+        resident_chunks: Optional[int] = None,
+        spill_dir: Optional[str] = None,
     ):
+        """`resident_chunks` bounds the chunks held in RAM (None = keep
+        everything, the legacy behavior); eviction of a dirty chunk
+        spills it under `spill_dir` (the same directory later `save`
+        calls must use — asserted there), so a budget REQUIRES one."""
         if n_virtual < 1:
             raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
         if chunk_clients < 1:
             raise ValueError(
                 f"chunk_clients must be >= 1, got {chunk_clients}"
             )
+        if resident_chunks is not None:
+            if resident_chunks < 1:
+                raise ValueError(
+                    f"resident_chunks must be >= 1, got {resident_chunks}"
+                )
+            if spill_dir is None:
+                raise ValueError(
+                    "a resident-chunk budget needs a spill_dir: evicting "
+                    "a dirty chunk must write its bytes somewhere"
+                )
         self.n_virtual = int(n_virtual)
         self.chunk_clients = int(chunk_clients)
+        self.resident_chunks = (
+            int(resident_chunks) if resident_chunks is not None else None
+        )
+        self._spill_dir = os.path.abspath(spill_dir) if spill_dir else None
         self.shard_ids = np.asarray(shard_ids, np.int64).reshape(-1)
         self.sample_counts = np.asarray(sample_counts, np.int64).reshape(-1)
         if self.shard_ids.shape[0] != n_virtual:
@@ -100,7 +208,9 @@ class ClientStore:
         self._fills: Dict[str, np.ndarray] = {}
         # chunk id -> {field name -> [rows_in_chunk, *(row shape)]};
         # a chunk dict may lack fields registered after it materialized —
-        # those fall back to the fill row on gather
+        # those fall back to the fill row on gather. Insertion order IS
+        # the LRU order: touches reinsert at the end, eviction pops the
+        # front.
         self._chunks: Dict[int, Dict[str, np.ndarray]] = {}
         self._dirty: set = set()
         self._files: Dict[int, str] = {}  # chunk id -> current filename
@@ -109,6 +219,33 @@ class ClientStore:
         # crashed run but not yet re-registered by this one (lazy rho
         # fields) — validated at re-registration time
         self._saved_fields: Dict[str, dict] = {}
+        # spilled-store telemetry (obs: `store_summary` / the `memory`
+        # record's store block): evictions under the residency budget,
+        # bytes the dirty-spill path wrote, chunk-file reads gathers
+        # served off disk (cache misses — see _read_chunk)
+        self.evictions = 0
+        self.spill_bytes = 0
+        self.spill_reads = 0
+        # chunk-file versions some retained MANIFEST references: a
+        # spill may delete the version it supersedes only when no
+        # manifest names it (resume must reach every retained
+        # snapshot); maintained by save()'s GC scan and load()
+        self._protected: set = set()
+        # parsed mmap views per chunk FILE (versions are immutable, so
+        # entries never go stale): one zip parse serves every field of
+        # a gather batch instead of fields × chunks parses. Small FIFO
+        # bound — mappings are virtual memory, but the handles are not
+        # free. Guarded by _lock like everything else.
+        self._mmap_cache: Dict[str, Dict[str, np.ndarray]] = {}
+        self._mmap_cache_max = 8
+        # batched_writes() defers residency enforcement across a
+        # multi-field scatter (one eviction sweep per loop, not one per
+        # field — re-spilling the same chunk per field would multiply
+        # the spill I/O by the field count)
+        self._defer_budget = False
+        # one lock for every public operation: the cohort prefetcher
+        # gathers on a background thread (module docstring)
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------- fields
 
@@ -121,37 +258,41 @@ class ClientStore:
         corrupt every never-sampled client.
         """
         row = np.asarray(fill_row)
-        if name in self._fills:
-            if (
-                self._fills[name].shape != row.shape
-                or self._fills[name].dtype != row.dtype
-                or not np.array_equal(
-                    self._fills[name], row, equal_nan=True
-                )
+        with self._lock:
+            if name in self._fills:
+                if (
+                    self._fills[name].shape != row.shape
+                    or self._fills[name].dtype != row.dtype
+                    or not np.array_equal(
+                        self._fills[name], row, equal_nan=True
+                    )
+                ):
+                    raise ValueError(
+                        f"field {name!r} re-registered with a different "
+                        "fill row (shape/dtype/value mismatch)"
+                    )
+                return
+            saved = self._saved_fields.get(name)
+            if saved is not None and (
+                list(row.shape) != list(saved["shape"])
+                or str(row.dtype) != saved["dtype"]
             ):
                 raise ValueError(
-                    f"field {name!r} re-registered with a different fill "
-                    "row (shape/dtype/value mismatch)"
+                    f"client-store field {name!r} was saved with shape "
+                    f"{saved['shape']} dtype {saved['dtype']} but this run "
+                    f"registers shape {list(row.shape)} dtype {row.dtype}"
                 )
-            return
-        saved = self._saved_fields.get(name)
-        if saved is not None and (
-            list(row.shape) != list(saved["shape"])
-            or str(row.dtype) != saved["dtype"]
-        ):
-            raise ValueError(
-                f"client-store field {name!r} was saved with shape "
-                f"{saved['shape']} dtype {saved['dtype']} but this run "
-                f"registers shape {list(row.shape)} dtype {row.dtype}"
-            )
-        self._fills[name] = row.copy()
+            self._fills[name] = row.copy()
 
     def has_field(self, name: str) -> bool:
-        return name in self._fills
+        with self._lock:
+            return name in self._fills
 
     @property
     def fields(self):
-        return tuple(sorted(self._fills))
+        with self._lock:  # the prefetch thread snapshots this while
+            # the main thread may be registering a group's first rho/ef
+            return tuple(sorted(self._fills))
 
     @property
     def saved_fields(self) -> Dict[str, dict]:
@@ -180,54 +321,217 @@ class ClientStore:
             )
         return ids
 
+    def _by_chunk(self, ids: np.ndarray):
+        """`(cid, positions, local_rows)` groups of a checked id vector —
+        one entry per touched chunk, positions indexing the caller's
+        id/row order (the vectorized replacement for a per-id loop)."""
+        cids = ids // self.chunk_clients
+        out = []
+        for cid in np.unique(cids):
+            pos = np.nonzero(cids == cid)[0]
+            out.append(
+                (int(cid), pos, ids[pos] - int(cid) * self.chunk_clients)
+            )
+        return out
+
+    def _touch(self, cid: int) -> None:
+        """Move a resident chunk to the LRU tail (most recently used)."""
+        self._chunks[cid] = self._chunks.pop(cid)
+
     def gather(self, name: str, ids: np.ndarray) -> np.ndarray:
         """Rows of field `name` for `ids`, as a fresh `[len(ids), ...]`
         array (never a view into the store — the caller device_puts and
-        possibly donates it)."""
-        ids = self._check_ids(ids)
-        fill = self._fills[name]
-        out = np.empty((ids.size,) + fill.shape, fill.dtype)
-        for pos, vid in enumerate(ids):
-            cid = self._chunk_of(vid)
-            chunk = self._chunks.get(cid)
-            if chunk is None or name not in chunk:
-                out[pos] = fill
-            else:
-                out[pos] = chunk[name][int(vid) - cid * self.chunk_clients]
-        return out
+        possibly donates it). Non-resident chunks with an on-disk
+        version serve their rows off a memory-mapped read without
+        rejoining the resident set — a gather never costs RAM beyond
+        its own output."""
+        with self._lock:
+            ids = self._check_ids(ids)
+            fill = self._fills[name]
+            out = np.empty((ids.size,) + fill.shape, fill.dtype)
+            for cid, pos, rows in self._by_chunk(ids):
+                chunk = self._chunks.get(cid)
+                if chunk is not None:
+                    self._touch(cid)
+                    if name in chunk:
+                        out[pos] = chunk[name][rows]
+                    else:
+                        out[pos] = fill
+                elif cid in self._files:
+                    arrs = self._read_chunk(self._files[cid])
+                    if name in arrs:
+                        out[pos] = arrs[name][rows]
+                    else:
+                        # field registered after this version was written
+                        out[pos] = fill
+                else:
+                    out[pos] = fill
+            return out
 
     def scatter(self, name: str, ids: np.ndarray, rows: np.ndarray) -> None:
         """Write `rows[i]` into client `ids[i]`'s slot of field `name`,
-        materializing (init-filled) chunks as needed and marking every
-        touched chunk dirty for the next `save`."""
-        ids = self._check_ids(ids)
-        rows = np.asarray(rows)
-        fill = self._fills[name]
-        if rows.shape != (ids.size,) + fill.shape:
-            raise ValueError(
-                f"scatter of field {name!r}: rows shape {rows.shape} != "
-                f"{(ids.size,) + fill.shape}"
-            )
-        if rows.dtype != fill.dtype:
-            raise ValueError(
-                f"scatter of field {name!r}: dtype {rows.dtype} != "
-                f"registered {fill.dtype} (an implicit cast here would "
-                "silently change restored state)"
-            )
-        for pos, vid in enumerate(ids):
-            cid = self._chunk_of(vid)
-            chunk = self._chunks.setdefault(cid, {})
-            if name not in chunk:
-                chunk[name] = np.broadcast_to(
-                    fill, (self._chunk_rows(cid),) + fill.shape
-                ).copy()
-            chunk[name][int(vid) - cid * self.chunk_clients] = rows[pos]
-            self._dirty.add(cid)
+        materializing (init-filled or disk-reloaded) chunks as needed
+        and marking every touched chunk dirty for the next `save`. The
+        residency budget is enforced AFTER the whole scatter — mid-
+        operation the resident set may exceed it by up to the cohort's
+        chunks (RSS stays O(resident + cohort))."""
+        with self._lock:
+            ids = self._check_ids(ids)
+            rows = np.asarray(rows)
+            fill = self._fills[name]
+            if rows.shape != (ids.size,) + fill.shape:
+                raise ValueError(
+                    f"scatter of field {name!r}: rows shape {rows.shape} "
+                    f"!= {(ids.size,) + fill.shape}"
+                )
+            if rows.dtype != fill.dtype:
+                raise ValueError(
+                    f"scatter of field {name!r}: dtype {rows.dtype} != "
+                    f"registered {fill.dtype} (an implicit cast here would "
+                    "silently change restored state)"
+                )
+            for cid, pos, local in self._by_chunk(ids):
+                chunk = self._chunks.get(cid)
+                if chunk is None:
+                    chunk = self._materialize(cid)
+                else:
+                    self._touch(cid)
+                if name not in chunk:
+                    chunk[name] = np.broadcast_to(
+                        fill, (self._chunk_rows(cid),) + fill.shape
+                    ).copy()
+                chunk[name][local] = rows[pos]
+                self._dirty.add(cid)
+            self._ensure_budget()
+
+    def _read_chunk(self, fname: str) -> Dict[str, np.ndarray]:
+        """Read-only array views of one on-disk chunk version, through
+        the per-file cache (versions are immutable): one zip parse
+        serves every field of a gather batch. `spill_reads` counts the
+        cache MISSES — actual file opens."""
+        arrs = self._mmap_cache.get(fname)
+        if arrs is None:
+            arrs = _mmap_npz(self._chunk_path(fname))
+            self.spill_reads += 1
+            self._mmap_cache[fname] = arrs
+            while len(self._mmap_cache) > self._mmap_cache_max:
+                self._mmap_cache.pop(next(iter(self._mmap_cache)))
+        return arrs
+
+    def _materialize(self, cid: int) -> Dict[str, np.ndarray]:
+        """Bring chunk `cid` into the resident set for writing: a full
+        (writable) copy of its on-disk version when one exists, else an
+        empty dict whose fields fill lazily."""
+        if cid in self._files:
+            chunk = {
+                k: np.array(v)  # writable copies off the shared mmap
+                for k, v in self._read_chunk(self._files[cid]).items()
+            }
+        else:
+            chunk = {}
+        self._chunks[cid] = chunk
+        return chunk
 
     def touched_chunks(self, ids: np.ndarray) -> set:
         """Chunk ids a scatter of `ids` dirties (the O(C) bound of one
         loop's checkpoint delta: ≤ len(ids) chunks + the manifest)."""
         return {self._chunk_of(v) for v in self._check_ids(ids)}
+
+    # --------------------------------------------------------- residency
+
+    @contextlib.contextmanager
+    def batched_writes(self):
+        """Defer residency enforcement to the end of a multi-field
+        write batch (the trainer's cohort scatter: one scatter call per
+        field over the same chunks). Without this, each field's scatter
+        would spill the over-budget chunks and the next field's would
+        reload them — full chunk I/O multiplied by the field count.
+        Inside the batch the resident set may exceed the budget by the
+        cohort's chunks, the same O(resident + cohort) transient the
+        per-call rule allows. No-op without a budget."""
+        with self._lock:
+            self._defer_budget = True
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._defer_budget = False
+                self._ensure_budget()
+
+    def _ensure_budget(self) -> None:
+        """Evict LRU chunks until the resident set fits the budget.
+
+        Clean chunks (current version on disk) drop for free; dirty
+        ones spill — written as the next version through the same
+        tmp+fsync+rename path `save` uses, so the following manifest
+        just references the file. Invariant: every clean materialized
+        chunk HAS a file (chunks materialize dirty and only become
+        clean via save/spill, or arrive clean from a load), so eviction
+        never loses the only copy. A spill deletes the version it
+        supersedes when NO retained manifest references it
+        (`_protected`) — otherwise a long run without checkpoints
+        would accumulate one full dead chunk file per eviction, and
+        only `save`'s GC (which such a run never reaches) could
+        reclaim them.
+        """
+        if self.resident_chunks is None or self._defer_budget:
+            return
+        while len(self._chunks) > self.resident_chunks:
+            cid = next(iter(self._chunks))  # LRU head
+            if cid in self._dirty or cid not in self._files:
+                old = self._files.get(cid)
+                self.spill_bytes += self._write_chunk(cid, self._spill_dir)
+                self._dirty.discard(cid)
+                if old is not None and old not in self._protected:
+                    self._mmap_cache.pop(old, None)
+                    try:
+                        os.remove(self._chunk_path(old))
+                    except OSError:
+                        pass  # best-effort, like save's GC
+            del self._chunks[cid]
+            self.evictions += 1
+
+    def _root(self, directory: str) -> str:
+        return os.path.abspath(os.path.join(directory, "client_store"))
+
+    def _chunk_path(self, fname: str) -> str:
+        # chunk files live under the spill/save root; the two are
+        # asserted identical in save()
+        return os.path.join(self._root(self._save_dir), fname)
+
+    # the directory chunk files are read back from: the spill dir until
+    # a save/load names one (they must agree — see save)
+    @property
+    def _save_dir(self) -> str:
+        if self._dir is not None:
+            return self._dir
+        if self._spill_dir is not None:
+            return self._spill_dir
+        raise RuntimeError(
+            "no chunk directory known yet (no save/load happened and no "
+            "spill_dir was configured)"
+        )
+
+    _dir: Optional[str] = None
+
+    def _write_chunk(self, cid: int, directory: str) -> int:
+        """One chunk -> its next versioned `.npz` (tmp+fsync+rename);
+        updates `_files` and returns the bytes written. THE one chunk
+        writer — `save` and the dirty-spill eviction share it, so the
+        on-disk format and the GC's filename rules cannot drift."""
+        root = self._root(directory)
+        os.makedirs(root, exist_ok=True)
+        self._seq += 1
+        fname = f"chunk_{cid:06d}_v{self._seq:08d}.npz"
+        tmp = os.path.join(root, f".tmp_{fname}")
+        with open(tmp, "wb") as f:
+            np.savez(f, **self._chunks[cid])
+            f.flush()
+            os.fsync(f.fileno())
+        nbytes = os.path.getsize(tmp)
+        os.replace(tmp, os.path.join(root, fname))
+        self._files[cid] = fname
+        return int(nbytes)
 
     # --------------------------------------------------------- checkpointing
 
@@ -250,49 +554,56 @@ class ClientStore:
         overwritten in place. After the manifest commit, manifests older
         than the newest `keep_manifests` are pruned and chunk files no
         retained manifest references (superseded versions, crashed-save
-        orphans, stale `.tmp_` staging files) are garbage-collected —
-        resume therefore reaches the newest `keep_manifests` snapshots;
-        falling back further (multiple consecutive torn checkpoints)
-        fails loudly in `load` rather than restoring silently-wrong
-        rows.
+        orphans, eviction spills the crashed run never committed, stale
+        `.tmp_` staging files) are garbage-collected — resume therefore
+        reaches the newest `keep_manifests` snapshots; falling back
+        further (multiple consecutive torn checkpoints) fails loudly in
+        `load` rather than restoring silently-wrong rows. Under a
+        residency budget the now-all-clean resident set is shed back to
+        the budget before returning.
         """
-        root = os.path.abspath(os.path.join(directory, "client_store"))
-        os.makedirs(root, exist_ok=True)
-        for cid in sorted(self._dirty):
-            self._seq += 1
-            fname = f"chunk_{cid:06d}_v{self._seq:08d}.npz"
-            tmp = os.path.join(root, f".tmp_{fname}")
-            with open(tmp, "wb") as f:
-                np.savez(f, **self._chunks[cid])
+        with self._lock:
+            if self._spill_dir is not None and os.path.abspath(
+                directory
+            ) != self._spill_dir:
+                raise ValueError(
+                    f"save directory {directory!r} != configured spill "
+                    f"dir {self._spill_dir!r}: eviction-spilled chunk "
+                    "versions would be invisible to this manifest"
+                )
+            self._dir = os.path.abspath(directory)
+            root = self._root(directory)
+            os.makedirs(root, exist_ok=True)
+            for cid in sorted(self._dirty):
+                self._write_chunk(cid, directory)
+            self._dirty.clear()
+            manifest = {
+                "version": _MANIFEST_VERSION,
+                "step": int(step),
+                "n_virtual": self.n_virtual,
+                "chunk_clients": self.chunk_clients,
+                "seq": self._seq,
+                "chunks": {
+                    str(c): f for c, f in sorted(self._files.items())
+                },
+                "fields": {
+                    name: {
+                        "shape": list(row.shape),
+                        "dtype": str(row.dtype),
+                    }
+                    for name, row in sorted(self._fills.items())
+                },
+            }
+            path = _manifest_path(root, step)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
                 f.flush()
                 os.fsync(f.fileno())
-            os.replace(tmp, os.path.join(root, fname))
-            self._files[cid] = fname
-        self._dirty.clear()
-        manifest = {
-            "version": _MANIFEST_VERSION,
-            "step": int(step),
-            "n_virtual": self.n_virtual,
-            "chunk_clients": self.chunk_clients,
-            "seq": self._seq,
-            "chunks": {str(c): f for c, f in sorted(self._files.items())},
-            "fields": {
-                name: {
-                    "shape": list(row.shape),
-                    "dtype": str(row.dtype),
-                }
-                for name, row in sorted(self._fills.items())
-            },
-        }
-        path = _manifest_path(root, step)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        self._gc(root)
-        return path
+            os.replace(tmp, path)
+            self._gc(root)
+            self._ensure_budget()
+            return path
 
     def _gc(self, root: str) -> None:
         """Prune old manifests, then delete unreferenced files.
@@ -301,7 +612,10 @@ class ClientStore:
         to reclaim, never fails the checkpoint. A torn (unparseable)
         retained manifest aborts chunk GC entirely — its references are
         unknowable, and deleting a chunk it might name would turn a
-        recoverable situation into data loss.
+        recoverable situation into data loss. Files named by the LIVE
+        `_files` map are always kept: an eviction-spilled version
+        written since the manifest above is the only copy of a clean
+        evicted chunk's current state.
         """
         def is_manifest(entry: str) -> bool:
             # committed manifests only: a crashed writer's staging file
@@ -323,15 +637,24 @@ class ClientStore:
                 os.remove(_manifest_path(root, s))
             except OSError:
                 pass
-        referenced = set()
+        manifest_refs = set()
         for entry in os.listdir(root):
             if not is_manifest(entry):
                 continue
             try:
                 with open(os.path.join(root, entry)) as f:
-                    referenced.update(json.load(f).get("chunks", {}).values())
+                    manifest_refs.update(
+                        json.load(f).get("chunks", {}).values()
+                    )
             except (OSError, ValueError):
-                return  # torn retained manifest: references unknowable
+                # torn retained manifest: references unknowable — keep
+                # everything (spills must then protect the live map too)
+                self._protected |= set(self._files.values())
+                return
+        # what eviction spills must never delete: every retained
+        # manifest's versions (resume reaches any of those snapshots)
+        self._protected = set(manifest_refs)
+        referenced = manifest_refs | set(self._files.values())
         for entry in os.listdir(root):
             stale = entry.startswith("chunk_") and entry not in referenced
             if stale or entry.startswith(".tmp_") or entry.endswith(
@@ -345,7 +668,12 @@ class ClientStore:
     def load(self, directory: str, step: int) -> None:
         """Restore the snapshot `save(directory, step)` committed.
 
-        Chunks named by the manifest are loaded; everything else reverts
+        Chunks named by the manifest become addressable (their files are
+        stat-checked now so a half-deleted store fails at restore, not
+        mid-run) but are NOT read into RAM: gathers serve rows off the
+        memory-mapped files and scatters materialize on demand — a
+        restored million-client store costs no more resident memory
+        than a fresh one. Everything the manifest doesn't name reverts
         to pristine. Field fills are NOT restored from disk — the caller
         re-registers them from the same deterministic init it built them
         with (common-seed model init), and the manifest's recorded
@@ -353,76 +681,118 @@ class ClientStore:
         config drift (different model, different rho shape) fails loudly
         instead of broadcasting the wrong fill under restored chunks.
         """
-        root = os.path.abspath(os.path.join(directory, "client_store"))
-        path = _manifest_path(root, step)
-        if not os.path.exists(path):
-            raise FileNotFoundError(
-                f"no client-store manifest for step {step} under {root} "
-                "(the checkpoint was written without cohort mode, or the "
-                "store snapshot was deleted)"
-            )
-        with open(path) as f:
-            manifest = json.load(f)
-        if manifest.get("version") != _MANIFEST_VERSION:
-            raise ValueError(
-                f"client-store manifest version {manifest.get('version')} "
-                f"!= supported {_MANIFEST_VERSION}"
-            )
-        for key, mine in (
-            ("n_virtual", self.n_virtual),
-            ("chunk_clients", self.chunk_clients),
-        ):
-            if int(manifest[key]) != mine:
+        with self._lock:
+            if self._spill_dir is not None and os.path.abspath(
+                directory
+            ) != self._spill_dir:
                 raise ValueError(
-                    f"client-store manifest {key}={manifest[key]} but this "
-                    f"run configured {mine}: the snapshot indexes a "
-                    "different virtual population and cannot be restored "
-                    "onto it"
+                    f"load directory {directory!r} != configured spill "
+                    f"dir {self._spill_dir!r}"
                 )
-        for name, meta in manifest.get("fields", {}).items():
-            if name in self._fills:
-                row = self._fills[name]
-                if (
-                    list(row.shape) != list(meta["shape"])
-                    or str(row.dtype) != meta["dtype"]
-                ):
+            root = self._root(directory)
+            path = _manifest_path(root, step)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"no client-store manifest for step {step} under "
+                    f"{root} (the checkpoint was written without cohort "
+                    "mode, or the store snapshot was deleted)"
+                )
+            with open(path) as f:
+                manifest = json.load(f)
+            if manifest.get("version") != _MANIFEST_VERSION:
+                raise ValueError(
+                    f"client-store manifest version "
+                    f"{manifest.get('version')} != supported "
+                    f"{_MANIFEST_VERSION}"
+                )
+            for key, mine in (
+                ("n_virtual", self.n_virtual),
+                ("chunk_clients", self.chunk_clients),
+            ):
+                if int(manifest[key]) != mine:
                     raise ValueError(
-                        f"client-store field {name!r} was saved with "
-                        f"shape {meta['shape']} dtype {meta['dtype']} but "
-                        f"this run registered shape {list(row.shape)} "
-                        f"dtype {row.dtype}"
+                        f"client-store manifest {key}={manifest[key]} but "
+                        f"this run configured {mine}: the snapshot indexes "
+                        "a different virtual population and cannot be "
+                        "restored onto it"
                     )
-        self._chunks.clear()
-        self._dirty.clear()
-        self._files = {
-            int(c): fname for c, fname in manifest["chunks"].items()
-        }
-        self._seq = int(manifest.get("seq", 0))
-        self._saved_fields = dict(manifest.get("fields", {}))
-        for cid, fname in self._files.items():
-            with np.load(os.path.join(root, fname)) as z:
-                self._chunks[cid] = {k: z[k] for k in z.files}
+            for name, meta in manifest.get("fields", {}).items():
+                if name in self._fills:
+                    row = self._fills[name]
+                    if (
+                        list(row.shape) != list(meta["shape"])
+                        or str(row.dtype) != meta["dtype"]
+                    ):
+                        raise ValueError(
+                            f"client-store field {name!r} was saved with "
+                            f"shape {meta['shape']} dtype {meta['dtype']} "
+                            f"but this run registered shape "
+                            f"{list(row.shape)} dtype {row.dtype}"
+                        )
+            files = {
+                int(c): fname for c, fname in manifest["chunks"].items()
+            }
+            missing = [
+                f
+                for f in files.values()
+                if not os.path.exists(os.path.join(root, f))
+            ]
+            if missing:
+                raise FileNotFoundError(
+                    f"client-store manifest step {step} names chunk "
+                    f"file(s) that do not exist under {root}: "
+                    f"{sorted(missing)[:4]}"
+                )
+            self._dir = os.path.abspath(directory)
+            self._chunks.clear()
+            self._dirty.clear()
+            self._mmap_cache.clear()
+            self._files = files
+            # conservative: this manifest's versions are committed (and
+            # a sibling retained manifest may reference more — the next
+            # save's GC scan refines the set); spills must not delete
+            # any of them
+            self._protected |= set(files.values())
+            self._seq = int(manifest.get("seq", 0))
+            self._saved_fields = dict(manifest.get("fields", {}))
 
     # ------------------------------------------------------------- summary
 
     def materialized_chunks(self) -> int:
         return len(self._chunks)
 
+    def residency(self) -> dict:
+        """The small live digest the trainer folds into each round's
+        `memory` record and the `watch` status sidecar (docs/SCALE.md
+        §Spilled store): resident/on-disk chunk counts, the budget, and
+        the eviction/spill counters."""
+        with self._lock:
+            return {
+                "resident_chunks": len(self._chunks),
+                "resident_budget": self.resident_chunks,
+                "on_disk_chunks": len(self._files),
+                "evictions": int(self.evictions),
+                "spill_bytes": int(self.spill_bytes),
+                "spill_reads": int(self.spill_reads),
+            }
+
     def summary(self) -> dict:
         """Small host-memory/occupancy digest for the end-of-run log."""
-        rows = sum(
-            next(iter(c.values())).shape[0] if c else 0
-            for c in self._chunks.values()
-        )
-        nbytes = sum(
-            a.nbytes for c in self._chunks.values() for a in c.values()
-        )
-        return {
-            "n_virtual": self.n_virtual,
-            "chunk_clients": self.chunk_clients,
-            "chunks_total": -(-self.n_virtual // self.chunk_clients),
-            "chunks_materialized": len(self._chunks),
-            "rows_materialized": int(rows),
-            "host_bytes": int(nbytes),
-            "fields": list(self.fields),
-        }
+        with self._lock:
+            rows = sum(
+                next(iter(c.values())).shape[0] if c else 0
+                for c in self._chunks.values()
+            )
+            nbytes = sum(
+                a.nbytes for c in self._chunks.values() for a in c.values()
+            )
+            return {
+                "n_virtual": self.n_virtual,
+                "chunk_clients": self.chunk_clients,
+                "chunks_total": -(-self.n_virtual // self.chunk_clients),
+                "chunks_materialized": len(self._chunks),
+                "rows_materialized": int(rows),
+                "host_bytes": int(nbytes),
+                "fields": list(self.fields),
+                **self.residency(),
+            }

@@ -119,6 +119,24 @@ class ExperimentConfig:
     # stays small enough that a net-sized model's chunk is ~16 MB;
     # raise it for tiny models where per-file overhead dominates.
     store_chunk_clients: int = 64
+    # LRU bound on store chunks held in RAM (clients/store.py,
+    # docs/SCALE.md §Spilled store): beyond it, clean chunks evict (a
+    # later gather memory-maps their `.npz` back in) and dirty chunks
+    # spill to `checkpoint_dir/client_store` first — host RSS becomes
+    # O(resident + cohort), flat in the virtual population, which is
+    # what lets one host run N=1M virtual clients. None = resident
+    # forever (the legacy keep-everything behavior). Approximate bytes
+    # budget: resident_chunks * store_chunk_clients * row bytes
+    # (n_params * 4 for `flat`).
+    store_resident_chunks: int | None = None
+    # pipelined cohort prefetch (clients/prefetch.py, docs/SCALE.md
+    # §Prefetch lifecycle): gather loop n+1's cohort — store chunk
+    # reads, data shards, device puts — on a background thread while
+    # loop n trains, so the gather leaves the round wall. The adopted
+    # buffers are bit-identical to a cold gather's (`--no-prefetch` is
+    # the bitwise fallback); a dispatch-shape-only knob like fold_eval,
+    # excluded from the metric-stream tag.
+    prefetch: bool = True
 
     # loop nest sizes (reference src/federated_trio.py:20-22)
     nloop: int = 12  # outer loops over the partition groups
@@ -565,6 +583,19 @@ class ExperimentConfig:
                     "membership changes every loop (the cohort data "
                     "gather already keeps only C shards device-resident)"
                 )
+            if self.store_resident_chunks is not None:
+                if not isinstance(
+                    self.store_resident_chunks, int
+                ) or isinstance(self.store_resident_chunks, bool):
+                    raise ValueError(
+                        f"store_resident_chunks must be an int >= 1, got "
+                        f"{self.store_resident_chunks!r}"
+                    )
+                if self.store_resident_chunks < 1:
+                    raise ValueError(
+                        f"store_resident_chunks must be >= 1, got "
+                        f"{self.store_resident_chunks}"
+                    )
             object.__setattr__(self, "n_clients", int(self.cohort))
         else:
             # every cohort knob set away from its default without
@@ -584,11 +615,14 @@ class ExperimentConfig:
                 self.cohort_weighting != "uniform"
                 or self.cohort_seed != 0
                 or self.store_chunk_clients != chunk_default
+                or self.store_resident_chunks is not None
+                or not self.prefetch
             ):
                 raise ValueError(
-                    "cohort_weighting/cohort_seed/store_chunk_clients "
-                    "require virtual_clients (cohort sampling only exists "
-                    "over a virtual-client population)"
+                    "cohort_weighting/cohort_seed/store_chunk_clients/"
+                    "store_resident_chunks/prefetch require "
+                    "virtual_clients (cohort sampling only exists over a "
+                    "virtual-client population)"
                 )
         if self.store_chunk_clients < 1:
             raise ValueError(

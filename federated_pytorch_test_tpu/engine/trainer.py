@@ -24,6 +24,11 @@ chunked store into exactly these programs (the client axis is then the
 cohort, sharded over the mesh as ever), runs the loop's partition
 rounds unchanged — still one dispatch per round — and SCATTERS the
 survivors' state back before the loop's stream marker and checkpoint.
+By default the NEXT loop's gather is prefetched on a background thread
+while this loop trains (clients/prefetch.py — bitwise-identical
+adoption, `--no-prefetch` fallback), and the store's resident set can
+be LRU-bounded (`--store-resident-chunks`) so host RSS stays flat in N
+(docs/SCALE.md §Spilled store).
 """
 
 from __future__ import annotations
@@ -40,7 +45,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from federated_pytorch_test_tpu.clients import ClientStore, CohortSampler
+from federated_pytorch_test_tpu.clients import (
+    ClientStore,
+    CohortPrefetcher,
+    CohortSampler,
+)
 from federated_pytorch_test_tpu.consensus import quarantine_release_2f
 from federated_pytorch_test_tpu.data import (
     client_stats,
@@ -220,6 +229,7 @@ class Trainer:
         # order `jax.tree.leaves(self.stats)` yields at scatter time.
         self.store = None
         self.sampler = None
+        self._prefetch = None
         if self._cohort_mode:
             n_v = cfg.virtual_clients
             # THE shard assignment + honest per-client sample counts
@@ -227,9 +237,34 @@ class Trainer:
             shard_ids, sample_counts = virtual_shard_assignment(
                 source.train_images.shape[0], n_v, n_shards
             )
+            if (
+                cfg.store_resident_chunks is not None
+                and jax.process_count() > 1
+            ):
+                # every process holds the full host-side store and
+                # would race the SAME deterministic chunk filenames in
+                # the shared spill dir (save() is process-0-gated for
+                # exactly this reason, but evictions fire at scatter
+                # time on every process). The multi-host client axis is
+                # ROADMAP 4d — per-host shard-local stores land there.
+                raise NotImplementedError(
+                    "store_resident_chunks on a multi-process mesh is "
+                    "not supported: eviction spills would race on the "
+                    "shared spill directory (single-writer rule)"
+                )
             self.store = ClientStore(
                 n_v, shard_ids, sample_counts,
                 chunk_clients=cfg.store_chunk_clients,
+                # spilled residency (docs/SCALE.md §Spilled store): the
+                # LRU budget bounds host RSS flat in N; evicted dirty
+                # chunks spill under the checkpoint dir, where the next
+                # manifest commits them like any other chunk version
+                resident_chunks=cfg.store_resident_chunks,
+                spill_dir=(
+                    cfg.checkpoint_dir
+                    if cfg.store_resident_chunks is not None
+                    else None
+                ),
             )
             self.store.register_field("flat", np.asarray(flat0))
             stats_leaves, self._stats_def = jax.tree_util.tree_flatten(stats)
@@ -268,6 +303,15 @@ class Trainer:
             # (they follow it into whatever cohort slot it lands in);
             # cycled exactly like the legacy per-client stats
             self._vmean, self._vstd = client_stats(n_v, cfg.biased_input)
+            # pipelined cohort prefetch (clients/prefetch.py): loop
+            # n+1's gather runs on a background thread while loop n
+            # trains. Single-process only: a background jit/device_put
+            # on global arrays would break the every-process-same-order
+            # launch rule of multi-controller jax — multi-host runs
+            # gather synchronously (the per-host shard-local gather is
+            # ROADMAP 4d).
+            if cfg.prefetch and jax.process_count() == 1:
+                self._prefetch = CohortPrefetcher(self._prefetch_worker)
 
         # transformer-family checkpoints carry the fused-qkv column-order
         # version: the layout changed between rounds (head-major v2,
@@ -757,13 +801,19 @@ class Trainer:
         # (tests/test_health.py splice-accepted regression). The flight/
         # memory/profiler knobs are analysis-only in the same sense:
         # rings, bundles, RSS reads, and profiler windows never touch
-        # the trajectory (tests/test_flight.py).
+        # the trajectory (tests/test_flight.py). `prefetch` is a
+        # dispatch-shape knob like fold_eval (the adopted gather is
+        # bit-identical to a cold one — tests/test_prefetch.py) and
+        # `store_resident_chunks` a memory-shape one (residency never
+        # changes a gathered byte): a resumed run may flip either and
+        # still splice.
         for k in (
             "metrics_stream", "trace_out", "profile_dir", "resume",
             "compile_cache", "fold_eval", "async_eval",
             "health_monitor", "health_window",
             "flight_recorder", "flight_window", "memory_telemetry",
             "profile_on_anomaly", "profile_budget",
+            "prefetch", "store_resident_chunks",
         ):
             d.pop(k, None)
         cfg_tag = hashlib.md5(
@@ -1102,19 +1152,6 @@ class Trainer:
             return arr
         return np.asarray(arr)[..., self.sampler.cohort(nloop)]
 
-    def _store_gids(self, prefix: str) -> list:
-        """Partition groups with a persistent per-virtual-client field
-        of `prefix` ('rho' / 'ef') in the store (registered at the
-        group's first scatter)."""
-        return [
-            int(name.split("/", 1)[1])
-            for name in self.store.fields
-            if name.startswith(prefix + "/")
-        ]
-
-    def _rho_gids(self) -> list:
-        return self._store_gids("rho")
-
     # per-virtual-client reliability counters (telemetry-steered
     # cohorts): scalar store fields, one row per client, accumulated at
     # scatter time from the loop's PURE fault schedule (speeds, masks,
@@ -1216,6 +1253,90 @@ class Trainer:
             cur = self.store.gather(name, ids)
             self.store.scatter(name, ids, cur + delta)
 
+    def _state_field_names(self) -> list:
+        """Every store field the cohort gather assembles into device
+        state, in gather order: `flat`, the batch-stats leaves, and the
+        lazily-registered per-group `rho/<gid>` / `ef/<gid>` rows. THE
+        one field list shared by the synchronous gather, the prefetch
+        worker, and prefetch adoption — a drifted copy would gather a
+        cohort missing a field."""
+        return ["flat", *self._stats_fields] + [
+            n for n in self.store.fields if n.startswith(("rho/", "ef/"))
+        ]
+
+    def _launch_prefetch(self, next_loop: int, known_dirty) -> None:
+        """Start the background gather of loop `next_loop`'s cohort
+        (clients/prefetch.py). Called at the weighting mode's decision
+        point: the sampler draw here IS the loop's draw (memoized; the
+        pure modes would re-derive it identically, the telemetry mode's
+        caller pins this after the scatter committed the reliability
+        history the draw reads)."""
+        if self._prefetch is None or next_loop >= self.cfg.nloop:
+            return
+        ids = self.sampler.cohort(next_loop)
+        self._prefetch.launch(next_loop, ids, known_dirty)
+
+    def _prefetch_worker(self, nloop: int, ids, known_dirty):
+        """The background half of the prefetch: store gathers, the
+        cohort's data-shard slices, and their device puts — everything
+        `_begin_loop_cohort`'s cold path does, off the round wall. Runs
+        on the prefetch thread; the store's lock serializes its chunk
+        reads against the main thread's scatter/save/evictions. Rows in
+        `known_dirty` may go stale under the overlapping scatter, so
+        state stays host-side for adoption-time patching unless the
+        overlap is provably empty (data shards and normalization stats
+        are static — never stale, always put here)."""
+        csh = client_sharding(self.mesh)
+        on_device = not np.intersect1d(ids, known_dirty).size
+        with self.recorder.phase(
+            "cohort_prefetch", record=False, nloop=nloop
+        ):
+            state = {
+                name: self.store.gather(name, ids)
+                for name in self._state_field_names()
+            }
+            if on_device:
+                state = {
+                    name: _owned_copy(self._put(arr, csh))
+                    for name, arr in state.items()
+                }
+            shards = self.store.shard_ids[ids]
+            data = (
+                self._put(self.fed.train_images[shards], csh),
+                self._put(self.fed.train_labels[shards], csh),
+                self._put(self._vmean[ids], csh),
+                self._put(self._vstd[ids], csh),
+            )
+        return {
+            "fields": tuple(state),
+            "state": state,
+            "on_device": on_device,
+            "known_dirty": np.asarray(known_dirty, np.int64),
+            "data": data,
+        }
+
+    def _adopt_prefetch(self, pre: dict, ids, csh) -> dict:
+        """Turn a prefetched payload into this loop's device state,
+        bit-identical to a cold gather: patch the overlap rows the
+        previous loop's scatter rewrote (they were unknowable at launch
+        — re-gathered here, post-scatter), put any still-host-side
+        fields, and gather fields registered after the launch (a
+        group's first-ever rho/ef scatter happened mid-prefetch)."""
+        state = dict(pre["state"])
+        if not pre["on_device"]:
+            overlap = np.nonzero(np.isin(ids, pre["known_dirty"]))[0]
+            for name in pre["fields"]:
+                arr = state[name]
+                if overlap.size:
+                    arr[overlap] = self.store.gather(name, ids[overlap])
+                state[name] = _owned_copy(self._put(arr, csh))
+        for name in self._state_field_names():
+            if name not in state:
+                state[name] = _owned_copy(
+                    self._put(self.store.gather(name, ids), csh)
+                )
+        return state
+
     def _begin_loop_cohort(self, nloop: int) -> None:
         """Gather loop `nloop`'s cohort out of the virtual-client store.
 
@@ -1257,39 +1378,67 @@ class Trainer:
             self._loop_quar = np.zeros(ids.size, np.float64)
         csh = client_sharding(self.mesh)
         with self.recorder.phase("cohort_gather", record=False, nloop=nloop):
-            self.flat = _owned_copy(
-                self._put(self.store.gather("flat", ids), csh)
+            # take() INSIDE the span: if the background gather has not
+            # finished, the blocking join lands on this wall — so the
+            # span honestly shows any un-overlapped residue, and the
+            # bench's prefetch_overlap_saved_s (off-span minus on-span)
+            # cannot report overlap that never happened
+            pre = (
+                self._prefetch.take(nloop, ids)
+                if self._prefetch is not None
+                else None
             )
-            leaves = [
-                _owned_copy(self._put(self.store.gather(name, ids), csh))
-                for name in self._stats_fields
-            ]
-            self.stats = jax.tree_util.tree_unflatten(self._stats_def, leaves)
-            self._rho_store = {
-                gid: _owned_copy(
-                    self._put(self.store.gather(f"rho/{gid}", ids), csh)
+            if pre is None:
+                state = {
+                    name: _owned_copy(
+                        self._put(self.store.gather(name, ids), csh)
+                    )
+                    for name in self._state_field_names()
+                }
+                shards = self.store.shard_ids[ids]
+                self.shard_imgs = self._put(
+                    self.fed.train_images[shards], csh
                 )
-                for gid in self._rho_gids()
-            }
+                self.shard_labels = self._put(
+                    self.fed.train_labels[shards], csh
+                )
+                self.mean = self._put(self._vmean[ids], csh)
+                self.std = self._put(self._vstd[ids], csh)
+            else:
+                # adopt the background gather (clients/prefetch.py):
+                # overlap rows are patched post-scatter, so the adopted
+                # bytes are bit-identical to a cold gather's
+                state = self._adopt_prefetch(pre, ids, csh)
+                (self.shard_imgs, self.shard_labels,
+                 self.mean, self.std) = pre["data"]
+            self.flat = state.pop("flat")
+            leaves = [state.pop(name) for name in self._stats_fields]
+            self.stats = jax.tree_util.tree_unflatten(self._stats_def, leaves)
             # error-feedback residuals follow the VIRTUAL client like
             # rho: a client's uncompensated compression error rejoins it
             # in whatever cohort slot it lands in (pristine rows gather
             # the zero fill — a first-ever exchange has lost nothing)
-            self._ef_store = {
-                gid: _owned_copy(
-                    self._put(self.store.gather(f"ef/{gid}", ids), csh)
-                )
-                for gid in self._store_gids("ef")
+            self._rho_store = {
+                int(n.split("/", 1)[1]): a
+                for n, a in state.items()
+                if n.startswith("rho/")
             }
-            shards = self.store.shard_ids[ids]
-            self.shard_imgs = self._put(self.fed.train_images[shards], csh)
-            self.shard_labels = self._put(self.fed.train_labels[shards], csh)
-            self.mean = self._put(self._vmean[ids], csh)
-            self.std = self._put(self._vstd[ids], csh)
+            self._ef_store = {
+                int(n.split("/", 1)[1]): a
+                for n, a in state.items()
+                if n.startswith("ef/")
+            }
         # the membership record: slot s of this loop's series holds
         # virtual client ids[s] — the slot->virtual-id key every other
         # per-client series of the loop is read against
         self.recorder.cohort(ids, nloop=nloop)
+        if self.cfg.cohort_weighting != "telemetry":
+            # pure-weighting decision point (docs/SCALE.md §Prefetch
+            # lifecycle): loop nloop+1's cohort is already a pure
+            # function of (seed, nloop+1), so its gather can overlap
+            # this whole loop's rounds. This loop's own cohort is the
+            # known-dirty set — the only rows the coming scatter writes.
+            self._launch_prefetch(nloop + 1, known_dirty=ids)
 
     def _end_loop_cohort(self, nloop: int) -> None:
         """Scatter the cohort's updated state back into the store.
@@ -1301,9 +1450,12 @@ class Trainer:
         by the blocking `_fetch`es below — which must complete before
         `commit_loop`'s stream marker and the checkpoint, so a crash
         never leaves the store behind the stream. Scatter must also
-        complete before the NEXT loop's gather: consecutive cohorts may
-        overlap, and a gather overtaking the scatter would hand the
-        shared member stale rows.
+        complete before the NEXT loop's gather reads any row it wrote:
+        consecutive cohorts may overlap, and a gather overtaking the
+        scatter would hand the shared member stale rows. With prefetch
+        on, the next gather may START earlier — the overlap rows are
+        re-gathered post-scatter at adoption, which preserves exactly
+        this ordering per row (clients/prefetch.py staleness rule).
         """
         ids = self._cohort_ids
         stats_leaves = jax.tree.leaves(self.stats)
@@ -1315,7 +1467,12 @@ class Trainer:
                 arr.copy_to_host_async()
             except AttributeError:
                 pass  # non-jax array (tests may inject numpy state)
-        with self.recorder.phase("cohort_scatter", record=False, nloop=nloop):
+        with self.recorder.phase(
+            "cohort_scatter", record=False, nloop=nloop
+        ), self.store.batched_writes():
+            # batched_writes: ONE residency-eviction sweep for the whole
+            # multi-field scatter (per-field enforcement would spill and
+            # reload the same over-budget chunks once per field)
             self.store.scatter("flat", ids, self._fetch(self.flat))
             for name, leaf in zip(self._stats_fields, stats_leaves):
                 self.store.scatter(name, ids, self._fetch(leaf))
@@ -1354,6 +1511,18 @@ class Trainer:
                 # once (docs/SCALE.md §Telemetry-steered cohorts)
                 self._update_telemetry(nloop, ids)
                 self._loop_quar = None
+        if self.cfg.cohort_weighting == "telemetry":
+            # telemetry decision point (docs/SCALE.md §Prefetch
+            # lifecycle): the draw reads reliability state this scatter
+            # just committed, so it pins HERE — scatter-finalize — and
+            # the launched gather overlaps the loop's commit tail
+            # (stream marker + checkpoint), still ahead of loop
+            # nloop+1's first dispatch. Nothing writes store ROWS
+            # between here and adoption (the checkpoint writes files),
+            # so the known-dirty set is empty.
+            self._launch_prefetch(
+                nloop + 1, known_dirty=np.empty(0, np.int64)
+            )
 
     def _fns(self, gid: int):
         if gid not in self._epoch_fns:
@@ -2097,9 +2266,15 @@ class Trainer:
         if self.cfg.memory_telemetry:
             # host RSS + device allocator stats (obs/memory.py): host
             # reads only, zero dispatches; a process fact, so
-            # stream=False keeps twin streams byte-identical
+            # stream=False keeps twin streams byte-identical. Cohort
+            # runs fold the store's live residency digest in — the
+            # spilled-store gate reads RSS and residency off the same
+            # record (and `watch` off the status sidecar it feeds).
+            mem = memory_record()
+            if self.store is not None:
+                mem["store"] = self.store.residency()
             self.recorder.log(
-                "memory", memory_record(), stream=False,
+                "memory", mem, stream=False,
                 nloop=nloop, group=gid,
             )
         self.recorder.log(
@@ -2238,6 +2413,10 @@ class Trainer:
             "incidents": len(self.recorder.series.get("incident", [])),
             "profile_captures": int(self._profile_captures),
         }
+        if self.store is not None:
+            # live store residency for `watch` (and the spill smoke's
+            # RSS-ceiling read rides the sidecar's memory block)
+            doc["store"] = self.store.residency()
         tmp = self._status_path + ".tmp"
         try:
             with open(tmp, "w") as f:
@@ -2973,6 +3152,10 @@ class Trainer:
         the flight recorder's crash bundle when a started run never
         completed, write the Chrome trace atomically, flush and close
         the metric sinks."""
+        if self._prefetch is not None:
+            # drop any in-flight prefetch: the daemon thread finishes
+            # into the void and its device buffers release
+            self._prefetch.cancel()
         if (
             self._flight is not None
             and self._run_started
@@ -3001,6 +3184,12 @@ class Trainer:
             except (OSError, ValueError):
                 doc = {}
             doc["completed" if self._run_completed else "crashed"] = True
+            if self.store is not None:
+                # the final residency digest: the per-round sidecar was
+                # last written BEFORE the closing scatter/save, and a
+                # finished run's `watch` panel should show where the
+                # store actually ended up
+                doc["store"] = self.store.residency()
             tmp = self._status_path + ".tmp"
             try:
                 with open(tmp, "w") as f:

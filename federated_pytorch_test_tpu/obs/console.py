@@ -216,6 +216,26 @@ def _render_run(name: str, run: RunStream) -> List[str]:
             parts.append(f"profiler captures {status['profile_captures']}")
         if parts:
             lines.append("   memory " + " | ".join(parts))
+        store = status.get("store")
+        if isinstance(store, dict):
+            # spilled client store (clients/store.py, docs/SCALE.md
+            # §Spilled store): live residency vs budget + what eviction
+            # has spilled — the bounded-RSS story at a glance
+            budget = store.get("resident_budget")
+            parts = [
+                f"resident {store.get('resident_chunks', '-')}"
+                + (f"/{budget}" if budget is not None else "")
+                + " chunks",
+                f"on disk {store.get('on_disk_chunks', '-')}",
+            ]
+            if store.get("evictions"):
+                parts.append(
+                    f"evictions {store['evictions']} "
+                    f"({_fmt_bytes(store.get('spill_bytes'))} spilled)"
+                )
+            if store.get("spill_reads"):
+                parts.append(f"spill reads {store['spill_reads']}")
+            lines.append("   store  " + " | ".join(parts))
     bundles = list_incidents(run.path)
     if bundles:
         names = []

@@ -166,6 +166,14 @@ class RunRegistry:
         schedule = None
         skipped_rounds = 0
         bytes_saved = 0
+        # the virtual-client axis (clients/, docs/SCALE.md): per-loop
+        # `cohort` membership records + the end-of-run participation
+        # digest — both streamed and twin-stable, unlike the store's
+        # residency/spill counters (process facts: they live in the
+        # `watch` sidecar and incident bundles, never in a report)
+        cohort_loops = 0
+        cohort_size = None
+        cohort_part = None
         for series, rec in run.records:
             if series == "comm_bytes":
                 cum_bytes += int(rec["value"])
@@ -203,6 +211,14 @@ class RunRegistry:
                         "accuracy": round(acc, 6) if acc is not None else None,
                     }
                 )
+            elif series == "cohort":
+                v = rec.get("value")
+                if isinstance(v, dict) and v.get("clients") is not None:
+                    cohort_loops += 1
+                    cohort_size = len(v["clients"])
+            elif series == "cohort_participation":
+                if isinstance(rec.get("value"), dict):
+                    cohort_part = rec["value"]
             elif series == "comm_summary":
                 comm_summary = rec["value"]
             elif series == "health":
@@ -248,6 +264,17 @@ class RunRegistry:
             "sim_round_wall_total_s": round(cum_sim_wall, 6),
             "curve": curve,
         }
+        if cohort_loops:
+            summary["cohort"] = {
+                "loops": cohort_loops,
+                "cohort_size": cohort_size,
+                "n_virtual": (
+                    cohort_part.get("n_virtual") if cohort_part else None
+                ),
+                "sampled_ever": (
+                    cohort_part.get("sampled_ever") if cohort_part else None
+                ),
+            }
         if deadlines:
             summary["deadline"] = {
                 "mean_s": round(sum(deadlines) / len(deadlines), 6),
@@ -434,6 +461,29 @@ def render_markdown(doc: dict) -> str:
             f"| {name} | {s['experiment']} | {cfg_label} | {s['evals']} "
             f"| {acc} | {s['total_comm_bytes']:,} | {s['exchanges']} "
             f"| {s['health']['anomalies']} |"
+        )
+    if any(s.get("cohort") for s in doc["runs"].values()):
+        lines += ["", "## Virtual-client fleet", ""]
+        lines.append(
+            "| run | population | cohort | loops | ever sampled |"
+        )
+        lines.append("|---|---|---|---|---|")
+        for name, s in doc["runs"].items():
+            c = s.get("cohort")
+            if not c:
+                continue
+            nv = c["n_virtual"] if c["n_virtual"] is not None else "-"
+            ev = c["sampled_ever"] if c["sampled_ever"] is not None else "-"
+            lines.append(
+                f"| {name} | {nv} | {c['cohort_size']} | {c['loops']} "
+                f"| {ev} |"
+            )
+        lines.append("")
+        lines.append(
+            "Store residency/spill and prefetch walls are process "
+            "facts (they differ across a crashed+resumed twin pair) — "
+            "they surface in `watch`'s sidecar panel and incident "
+            "bundles, never in a report."
         )
     lines += ["", "## Convergence vs bytes frontier", ""]
     lines.append(

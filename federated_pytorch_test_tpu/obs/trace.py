@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List
 
@@ -49,6 +50,14 @@ class TraceRecorder:
     def __init__(self, label: str = "fedtpu host"):
         self._t0 = time.perf_counter()
         self._pid = os.getpid()
+        # per-thread track ids: Chrome-trace complete ("X") events on
+        # ONE track must nest by time containment, and the cohort
+        # prefetcher's spans (clients/prefetch.py) deliberately OVERLAP
+        # the main thread's round spans — on a shared track Perfetto
+        # would mis-nest them. The constructing (main) thread keeps the
+        # historical track 0; each further thread gets the next small id.
+        self._tids: Dict[int, int] = {threading.get_ident(): 0}
+        self._tids_lock = threading.Lock()
         self.events: List[dict] = [
             {
                 "name": "process_name",
@@ -58,6 +67,16 @@ class TraceRecorder:
                 "args": {"name": label},
             }
         ]
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._tids_lock:  # two first-touching threads must not
+                # both read len() before either inserts (same track id
+                # == the very mis-nesting per-thread tracks prevent)
+                tid = self._tids.setdefault(ident, len(self._tids))
+        return tid
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -79,7 +98,7 @@ class TraceRecorder:
                     "ts": round(t0, 3),
                     "dur": round(self._now_us() - t0, 3),
                     "pid": self._pid,
-                    "tid": 0,
+                    "tid": self._tid(),
                     "args": args,
                 }
             )
@@ -94,7 +113,7 @@ class TraceRecorder:
                 "s": "t",
                 "ts": round(self._now_us(), 3),
                 "pid": self._pid,
-                "tid": 0,
+                "tid": self._tid(),
                 "args": args,
             }
         )

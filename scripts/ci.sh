@@ -63,6 +63,14 @@
 #                                    via rerun — store manifest + stream
 #                                    + cohort sequence all splice, twin
 #                                    stream-identity asserted),
+#                                    spill_smoke (the million-client
+#                                    shape: N=1M lazy virtual clients,
+#                                    --store-resident-chunks pinned to 2
+#                                    so evictions/spills fire, planned
+#                                    crash recovered via rerun with twin
+#                                    stream identity, and the bounded-
+#                                    RSS gate — sidecar peak RSS at 1M
+#                                    within 1.25x of the 10k run's),
 #                                    fleet_smoke (the closed loop at 10k
 #                                    virtual clients: churn + speed +
 #                                    corruption plan, --round-deadline
@@ -450,6 +458,105 @@ assert any(d.get("series") == "cohort_participation" for d in recs)
   rm -rf "$d"
 }
 
+spill_smoke() {
+  # Million-client fleet on one host through the REAL CLI (clients/,
+  # docs/SCALE.md §Spilled store): N=1,000,000 lazy virtual clients, a
+  # C=16 cohort per loop, the store's resident set pinned to TWO chunks
+  # (--store-resident-chunks 2, 8-client chunks) so every loop's
+  # scatter forces clean-chunk evictions and dirty-chunk spills, and a
+  # planned crash at (nloop=1, gid=2, nadmm=0) killing the first run
+  # while loop 1's prefetched gather is being consumed. Recovery is
+  # rerunning the IDENTICAL command; an uninterrupted twin proves
+  # crashed+resumed stream identity. The bounded-RSS gate reads peak
+  # host RSS off each run's status sidecar: the N=1M twin must land
+  # within 1.25x of an otherwise-identical N=10k run (flat in N) and
+  # under an absolute ceiling — a store that silently materialized the
+  # population would blow both.
+  local d; d="$(mktemp -d)"
+  local base=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 320 --synthetic-n-test 60 --batch 20
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --cohort 16 --data-shards 8 --cohort-seed 11
+    --store-chunk-clients 8 --store-resident-chunks 2
+    --save-model --resume auto)
+  local cmd=("${base[@]}" --virtual-clients 1000000
+    --fault-plan "seed=7,dropout=0.2,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${base[@]}" --virtual-clients 1000000
+    --fault-plan "seed=7,dropout=0.2"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  local small=("${base[@]}" --virtual-clients 10000
+    --fault-plan "seed=7,dropout=0.2"
+    --checkpoint-dir "$d/ckpt_small" --metrics-stream "$d/small.jsonl")
+  echo "spill smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "spill smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "spill smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "spill smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "spill smoke FAILED: the 1M twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  "${small[@]}" > "$d/small.log" 2>&1 || {
+    echo "spill smoke FAILED: the 10k baseline did not finish" >&2
+    tail -20 "$d/small.log" >&2; rm -rf "$d"; return 1
+  }
+  grep -q '# cohort: 16 of 1000000 virtual clients' "$d/run2.log" || {
+    echo "spill smoke FAILED: missing/incorrect cohort summary line" >&2
+    grep '# cohort' "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  grep -q '# store: .*eviction' "$d/run2.log" || {
+    echo "spill smoke FAILED: the residency budget forced no evictions" >&2
+    grep '# store' "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+cohorts = [d for d in recs if d.get("series") == "cohort"]
+assert len(cohorts) == 2, cohorts
+assert all(len(d["value"]["clients"]) == 16 for d in cohorts)
+assert any(d.get("series") == "cohort_participation" for d in recs)
+' || {
+    echo "spill smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  if ! python - "$d/twin.jsonl.status.json" "$d/small.jsonl.status.json" <<'PY'
+import json, sys
+big = json.load(open(sys.argv[1]))
+small = json.load(open(sys.argv[2]))
+for doc, name in ((big, "1M"), (small, "10k")):
+    assert doc.get("completed"), f"{name} sidecar not stamped completed"
+peak_big = big["memory"]["peak_rss_bytes"]
+peak_small = small["memory"]["peak_rss_bytes"]
+assert peak_big and peak_small, (peak_big, peak_small)
+ratio = peak_big / peak_small
+# flat in N: 100x the population, within 1.25x the peak RSS (the
+# store is lazy + spilled; what remains O(N) is int64 metadata and
+# the fault plan's per-round [nadmm, N] draws)
+assert ratio <= 1.25, f"peak RSS ratio 1M/10k = {ratio:.3f} > 1.25"
+# and an absolute sanity ceiling for the whole process (jax + data +
+# store): a population-sized store would be ~250 GB of flat rows
+assert peak_big < 6 * 2**30, f"peak RSS {peak_big/2**30:.2f} GiB >= 6 GiB"
+st = big.get("store") or {}
+assert st.get("resident_budget") == 2, st
+assert st.get("evictions", 0) > 0, st
+print(
+    f"spill smoke: peak RSS 1M={peak_big/2**20:.0f} MiB "
+    f"10k={peak_small/2**20:.0f} MiB (ratio {ratio:.3f}); "
+    f"evictions={st.get('evictions')} spill_bytes={st.get('spill_bytes')}"
+)
+PY
+  then
+    echo "spill smoke FAILED: bounded-RSS gate" >&2
+    rm -rf "$d"; return 1
+  fi
+  echo "spill smoke OK"
+  rm -rf "$d"
+}
+
 fleet_smoke() {
   # End-to-end CLOSED-LOOP fleet control through the REAL CLI (the
   # ROADMAP-item-3 scenario at population scale): 10k virtual clients
@@ -816,6 +923,7 @@ case "$tier" in
     bf16_smoke
     codec_smoke
     cohort_smoke
+    spill_smoke
     fleet_smoke
     report_smoke
     incident_smoke
@@ -828,6 +936,7 @@ case "$tier" in
     bf16_smoke
     codec_smoke
     cohort_smoke
+    spill_smoke
     fleet_smoke
     report_smoke
     incident_smoke

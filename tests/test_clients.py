@@ -279,6 +279,131 @@ def test_store_manifest_commit_is_atomic(tmp_path):
     )
 
 
+@pytest.mark.smoke
+def test_store_spill_eviction_mmap_bitwise(tmp_path):
+    # the spilled-store gate (docs/SCALE.md §Spilled store): a budget-1
+    # store — every scatter beyond one chunk forces an eviction (dirty
+    # chunks spill, clean ones drop) and gathers serve evicted rows off
+    # memory-mapped .npz reads — must hold EXACTLY the rows an
+    # unbounded in-RAM twin holds, bit for bit, through interleaved
+    # scatters, saves, and gathers
+    n, chunk = 64, 8
+    rng = np.random.default_rng(3)
+    d_s = str(tmp_path / "spill")
+    st = ClientStore(
+        n, np.arange(n) % 4, np.full(n, 5), chunk_clients=chunk,
+        resident_chunks=1, spill_dir=d_s,
+    )
+    twin = ClientStore(
+        n, np.arange(n) % 4, np.full(n, 5), chunk_clients=chunk
+    )
+    for s in (st, twin):
+        s.register_field("flat", np.zeros(4, np.float32))
+        s.register_field("telem", np.zeros((), np.float32))
+    for step in range(1, 5):
+        ids = np.sort(rng.choice(n, 6, replace=False))
+        rows = rng.normal(size=(6, 4)).astype(np.float32)
+        tel = rng.normal(size=6).astype(np.float32)
+        for s in (st, twin):
+            s.scatter("flat", ids, rows)
+            s.scatter("telem", ids, tel)
+        assert st.materialized_chunks() <= 1 + len(st.touched_chunks(ids))
+        if step == 2:
+            st.save(d_s, step)
+            twin.save(str(tmp_path / "twin"), step)
+    all_ids = np.arange(n)
+    for name in ("flat", "telem"):
+        np.testing.assert_array_equal(
+            st.gather(name, all_ids), twin.gather(name, all_ids)
+        )
+    res = st.residency()
+    assert res["resident_chunks"] <= 1
+    assert res["evictions"] > 0 and res["spill_reads"] > 0
+    assert res["spill_bytes"] > 0  # dirty evictions spilled real bytes
+    summ = st.summary()
+    for key in ("resident_chunks", "evictions", "spill_bytes"):
+        assert key in summ, summ
+    # a budget needs somewhere to spill
+    with pytest.raises(ValueError, match="spill_dir"):
+        ClientStore(n, np.zeros(n), np.ones(n), resident_chunks=1)
+    # and the save directory must be the spill directory — a manifest
+    # elsewhere could never reference the spilled versions
+    with pytest.raises(ValueError, match="spill"):
+        st.save(str(tmp_path / "elsewhere"), 9)
+
+
+@pytest.mark.smoke
+def test_store_lazy_load_serves_from_disk(tmp_path):
+    # load() makes manifest chunks addressable WITHOUT reading them
+    # into RAM: a restored million-client store must not cost O(touched)
+    # resident memory. Gathers read rows off the mmap; a scatter
+    # materializes (and re-dirties) just its chunks.
+    n, chunk = 48, 8
+    d = str(tmp_path)
+    st = ClientStore(n, np.zeros(n), np.ones(n), chunk_clients=chunk)
+    st.register_field("flat", np.arange(3, dtype=np.float32))
+    ids = np.array([0, 9, 40])
+    rows = np.stack([np.full(3, v, np.float32) for v in (1, 2, 3)])
+    st.scatter("flat", ids, rows)
+    st.save(d, 1)
+    st2 = ClientStore(n, np.zeros(n), np.ones(n), chunk_clients=chunk)
+    st2.register_field("flat", np.arange(3, dtype=np.float32))
+    st2.load(d, 1)
+    assert st2.materialized_chunks() == 0  # nothing resident
+    np.testing.assert_array_equal(
+        st2.gather("flat", np.array([9, 0, 40, 5])),
+        np.stack([rows[1], rows[0], rows[2],
+                  np.arange(3, dtype=np.float32)]),
+    )
+    assert st2.residency()["spill_reads"] > 0  # served off the mmap
+    assert st2.materialized_chunks() == 0  # gather never materializes
+    # scatter to a loaded chunk round-trips through the file copy
+    st2.scatter("flat", np.array([1]), np.full((1, 3), 7, np.float32))
+    np.testing.assert_array_equal(
+        st2.gather("flat", np.array([1, 0]))[1], rows[0]
+    )
+    # a half-deleted store fails at restore, not mid-run
+    st3 = ClientStore(n, np.zeros(n), np.ones(n), chunk_clients=chunk)
+    st3.register_field("flat", np.arange(3, dtype=np.float32))
+    root = os.path.join(d, "client_store")
+    victim = [f for f in os.listdir(root) if f.startswith("chunk_")][0]
+    os.rename(os.path.join(root, victim), os.path.join(root, victim) + ".gone")
+    with pytest.raises(FileNotFoundError, match="chunk file"):
+        st3.load(d, 1)
+
+
+@pytest.mark.smoke
+def test_store_spill_between_saves_stays_crash_safe(tmp_path):
+    # an eviction-spill written BETWEEN saves is uncommitted state: a
+    # crash before the next manifest leaves resume at the previous
+    # committed snapshot (the versioned-chunk fallback, unchanged), and
+    # the spilled orphan is GC'd by a later save rather than corrupting
+    # anything
+    n, chunk = 32, 8
+    d = str(tmp_path)
+    st = ClientStore(
+        n, np.zeros(n), np.ones(n), chunk_clients=chunk,
+        resident_chunks=1, spill_dir=d,
+    )
+    st.register_field("flat", np.zeros(2, np.float32))
+    st.scatter("flat", np.array([0]), np.ones((1, 2), np.float32))
+    st.save(d, 1)
+    # dirty two chunks; the budget spills the LRU one immediately
+    st.scatter("flat", np.array([0]), np.full((1, 2), 9, np.float32))
+    st.scatter("flat", np.array([17]), np.full((1, 2), 5, np.float32))
+    assert st.residency()["evictions"] > 0
+    # "crash": a fresh store restores the ONLY committed snapshot
+    st2 = ClientStore(n, np.zeros(n), np.ones(n), chunk_clients=chunk)
+    st2.register_field("flat", np.zeros(2, np.float32))
+    st2.load(d, 1)
+    np.testing.assert_array_equal(
+        st2.gather("flat", np.array([0]))[0], np.ones(2, np.float32)
+    )
+    np.testing.assert_array_equal(
+        st2.gather("flat", np.array([17]))[0], np.zeros(2, np.float32)
+    )
+
+
 # ------------------------------------------------------------- config gates
 
 
@@ -306,6 +431,15 @@ def test_config_cohort_validation():
         ExperimentConfig(
             virtual_clients=8, cohort=2, robust_agg="trimmed", robust_f=1
         )
+    # the spilled-store / prefetch knobs are cohort knobs like the rest
+    with pytest.raises(ValueError, match="store_resident_chunks"):
+        ExperimentConfig(
+            virtual_clients=8, cohort=4, store_resident_chunks=0
+        )
+    with pytest.raises(ValueError, match="virtual_clients"):
+        ExperimentConfig(store_resident_chunks=4)
+    with pytest.raises(ValueError, match="virtual_clients"):
+        ExperimentConfig(prefetch=False)
 
 
 # ---------------------------------------------------- engine-level contracts

@@ -1,0 +1,242 @@
+"""Pipelined cohort prefetch contracts (clients/prefetch.py, docs/SCALE.md).
+
+The perf claim is that loop n+1's cohort gather runs on a background
+thread while loop n trains; the CORRECTNESS claim — gated here — is that
+nothing observable changes:
+
+* **bitwise fallback** — prefetch-on trajectories (params, store rows,
+  every recorded series) equal prefetch-off's bit for bit, with the
+  cohort overlap case (consecutive cohorts sharing members, whose rows
+  the intervening scatter rewrites) deliberately forced;
+* **dispatch budget** — the folded round stays {round: 1, round_init: 1}
+  with the prefetch on (gather/adoption are host-side);
+* **decision points** — uniform weighting (decision pure in (seed,
+  nloop), gather overlaps the whole loop) AND telemetry weighting with
+  churn composed (decision pinned at scatter-finalize) both stream
+  byte-identically to the synchronous path, so the prefetch knob is
+  tag-excluded like the other dispatch-shape knobs;
+* **crash mid-prefetch** — a planned crash while a prefetch is in
+  flight resumes clean: the resumed stream and store equal an
+  uninterrupted twin's (slow tier; tier-2 spill_smoke runs the same
+  contract at N=1M through the real CLI).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.clients import CohortPrefetcher
+from federated_pytorch_test_tpu.data import synthetic_cifar
+from federated_pytorch_test_tpu.engine import ExperimentConfig, Trainer, get_preset
+
+SRC = synthetic_cifar(n_train=240, n_test=60)
+
+SERIES = (
+    "train_loss", "dual_residual", "primal_residual", "mean_rho",
+    "test_accuracy", "cohort", "cohort_weight", "availability",
+)
+
+
+def tiny(preset: str, **over) -> ExperimentConfig:
+    base = dict(
+        batch=40, nloop=3, max_groups=1, model="net",
+        check_results=True, eval_batch=30, synthetic_ok=True,
+    )
+    base.update(over)
+    return get_preset(preset, **base)
+
+
+def _run(cfg):
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    rec = tr.run()
+    return tr, rec
+
+
+def _assert_twin(tr_on, rec_on, tr_off, rec_off, n_virtual):
+    np.testing.assert_array_equal(
+        np.asarray(tr_on.flat), np.asarray(tr_off.flat)
+    )
+    ids = np.arange(n_virtual)
+    assert tr_on.store.fields == tr_off.store.fields
+    for name in tr_on.store.fields:
+        np.testing.assert_array_equal(
+            tr_on.store.gather(name, ids), tr_off.store.gather(name, ids)
+        )
+    for name in SERIES:
+        a = [r["value"] for r in rec_on.series.get(name, [])]
+        b = [r["value"] for r in rec_off.series.get(name, [])]
+        assert a == b, name
+
+
+# ------------------------------------------------------------ unit level
+
+
+@pytest.mark.smoke
+def test_prefetcher_match_discard_and_error_fallback():
+    def worker(nloop, ids, dirty):
+        if nloop == 9:
+            raise RuntimeError("boom")
+        return {"nloop": int(nloop), "dirty": list(dirty)}
+
+    p = CohortPrefetcher(worker)
+    assert p.take(0, [1, 2]) is None  # nothing pending
+    p.launch(1, np.array([1, 2]), np.array([2, 3]))
+    assert p.in_flight == 1
+    # mismatched loop or cohort: discard, caller gathers synchronously
+    # (the superseded thread finishes into the void)
+    assert p.take(2, np.array([1, 2])) is None
+    p.launch(1, np.array([1, 2]), np.array([], np.int64))
+    assert p.take(1, np.array([1, 3])) is None
+    # the matching take joins the thread and returns its payload
+    p.launch(3, np.array([4, 5]), np.array([7], np.int64))
+    assert p.take(3, np.array([4, 5])) == {"nloop": 3, "dirty": [7]}
+    assert p.in_flight is None
+    # a worker exception degrades to None + a warning, never a raise
+    p.launch(9, np.array([4, 5]), np.array([], np.int64))
+    with pytest.warns(UserWarning, match="boom"):
+        assert p.take(9, np.array([4, 5])) is None
+    # cancel drops the pending work
+    p.launch(4, np.array([6]), np.array([], np.int64))
+    p.cancel()
+    assert p.take(4, np.array([6])) is None
+
+
+# --------------------------------------------------- engine-level bitwise
+
+
+def test_prefetch_matches_sync_bitwise_with_overlap():
+    """THE fallback gate: prefetch-on == prefetch-off bit for bit —
+    params, store rows, every series — with C=4 of N=6, so consecutive
+    cohorts ALWAYS share members and the adoption-time overlap patch
+    (the rows the intervening scatter rewrote) is exercised every loop.
+    The folded dispatch budget survives alongside."""
+    common = dict(nadmm=2, virtual_clients=6, cohort=4, data_shards=4)
+    tr_on, rec_on = _run(tiny("fedavg", **common))
+    tr_off, rec_off = _run(tiny("fedavg", prefetch=False, **common))
+    assert tr_on._prefetch is not None and tr_off._prefetch is None
+    _assert_twin(tr_on, rec_on, tr_off, rec_off, 6)
+    for r in rec_on.series["dispatch_count"]:
+        assert r["value"] == {"round": 1, "round_init": 1, "total": 2}, r
+
+
+@pytest.mark.slow
+def test_prefetch_matches_sync_bitwise_admm_lazy_fields():
+    """The admm leg: per-group rho fields register at the group's FIRST
+    scatter — mid-prefetch for the loop-1 gather, exercising the
+    adoption path that gathers fields unknown at launch time."""
+    common = dict(
+        nadmm=3, bb_update=True, virtual_clients=6, cohort=4,
+        data_shards=4,
+    )
+    tr_on, rec_on = _run(tiny("admm", **common))
+    tr_off, rec_off = _run(tiny("admm", prefetch=False, **common))
+    _assert_twin(tr_on, rec_on, tr_off, rec_off, 6)
+    assert sorted(tr_on._rho_store) == sorted(tr_off._rho_store)
+    for g in tr_on._rho_store:
+        np.testing.assert_array_equal(
+            np.asarray(tr_on._rho_store[g]),
+            np.asarray(tr_off._rho_store[g]),
+        )
+
+
+def test_prefetch_stream_identity_telemetry_churn(tmp_path):
+    """The pinned decision point: telemetry weighting draws from
+    reliability state committed at scatter time, churn restricts the
+    pool — with prefetch on, the draw happens at scatter-finalize on
+    the main thread and the streamed records (cohort, cohort_weight,
+    availability included) are byte-identical to the synchronous
+    path's. The prefetch knob is tag-excluded, so the headers match
+    too (the splice-accepted rule for dispatch-shape knobs)."""
+    streams = {}
+    for on in (True, False):
+        cfg = tiny(
+            "fedavg",
+            nloop=2,
+            nadmm=2,
+            virtual_clients=12,
+            cohort=4,
+            data_shards=4,
+            cohort_weighting="telemetry",
+            fault_plan="seed=5,dropout=0.3,churn=0.3:2",
+            prefetch=on,
+            metrics_stream=str(tmp_path / f"p{int(on)}.jsonl"),
+        )
+        tr = Trainer(cfg, verbose=False, source=SRC)
+        tr.run()
+        out = []
+        for line in open(cfg.metrics_stream):
+            d = json.loads(line)
+            d.pop("t", None)
+            if d.get("series") == "step_time":
+                d["value"] = {
+                    k: v for k, v in d["value"].items() if k != "seconds"
+                }
+            out.append(d)
+        streams[on] = out
+    assert streams[True] == streams[False]
+    # headers included: prefetch must not enter the stream tag
+    assert streams[True][0]["event"] == "stream_header"
+
+
+@pytest.mark.slow
+def test_crash_mid_prefetch_resumes_clean(tmp_path):
+    """A planned crash at (nloop=0, gid, nadmm=1) fires while loop 1's
+    prefetch is in flight (it launched at loop 0's gather). The daemon
+    thread dies with the process; the rerun restores the checkpointed
+    store, re-gathers cold, and its stream + store equal an
+    uninterrupted twin's."""
+    from federated_pytorch_test_tpu.fault import InjectedCrash
+
+    def cfg_for(tag, plan):
+        return tiny(
+            "fedavg",
+            nloop=2,
+            nadmm=2,
+            virtual_clients=32,
+            cohort=4,
+            data_shards=4,
+            cohort_seed=9,
+            save_model=True,
+            resume="auto",
+            store_chunk_clients=8,
+            store_resident_chunks=2,
+            fault_plan=plan,
+            checkpoint_dir=str(tmp_path / f"ckpt_{tag}"),
+            metrics_stream=str(tmp_path / f"{tag}.jsonl"),
+        )
+
+    cfg = cfg_for("run", "seed=5,dropout=0.3,crash=0:2:1")
+    tr = Trainer(cfg, verbose=False, source=SRC)
+    with pytest.raises(InjectedCrash):
+        tr.run()
+    tr2 = Trainer(cfg, verbose=False, source=SRC)
+    tr2.run()
+    twin = Trainer(
+        cfg_for("twin", "seed=5,dropout=0.3"), verbose=False, source=SRC
+    )
+    twin.run()
+
+    def norm(path):
+        out = []
+        for line in open(path):
+            d = json.loads(line)
+            d.pop("t", None)
+            if d.get("event") == "stream_header":
+                d.pop("tag", None)  # plans differ by the crash point
+            if d.get("series") == "step_time":
+                d["value"] = {
+                    k: v for k, v in d["value"].items() if k != "seconds"
+                }
+            out.append(d)
+        return out
+
+    a = norm(str(tmp_path / "run.jsonl"))
+    b = norm(str(tmp_path / "twin.jsonl"))
+    assert a == b, f"streams differ: {len(a)} vs {len(b)} records"
+    ids = np.arange(32)
+    assert tr2.store.fields == twin.store.fields
+    for name in tr2.store.fields:
+        np.testing.assert_array_equal(
+            tr2.store.gather(name, ids), twin.store.gather(name, ids)
+        )
