@@ -712,6 +712,36 @@ def _prefetch_probe():
         out["store_resident_budget"] = res["resident_budget"]
         out["store_evictions"] = res["evictions"]
         out["store_spill_bytes"] = res["spill_bytes"]
+        # checksum overhead (storage-integrity PR, docs/FAULT.md
+        # §Storage-integrity axis): the verify-on-read gate is one
+        # crc32 pass over each spilled chunk's mmap before the view
+        # parse — measured as the warm full-population gather wall,
+        # checksums on minus off, over the spilled chunks the bounded
+        # run just wrote. The acceptance gate is ≈ 0 (crc32 is
+        # ~GB/s-scale on one core; the chunks here are a few MB);
+        # scheduler noise can read slightly negative — reported as
+        # measured. The mmap cache is cleared per rep so every rep
+        # pays the full read path, not a cache hit.
+        st = tr.store
+        ids = np.arange(n_virtual)
+        checksum_walls = {}
+        for checks in (True, False):
+            st.checksums = checks
+            st._mmap_cache.clear()
+            st.gather("flat", ids)  # warm: page cache + digest table
+            reps = []
+            for _ in range(5):
+                st._mmap_cache.clear()
+                t0 = time.perf_counter()
+                st.gather("flat", ids)
+                reps.append(time.perf_counter() - t0)
+            checksum_walls[checks] = float(np.median(reps))
+        st.checksums = True
+        out["gather_wall_checksums_on_s"] = round(checksum_walls[True], 5)
+        out["gather_wall_checksums_off_s"] = round(checksum_walls[False], 5)
+        out["checksum_overhead_s"] = round(
+            checksum_walls[True] - checksum_walls[False], 5
+        )
         tr.close()
     finally:
         shutil.rmtree(d, ignore_errors=True)
@@ -1187,8 +1217,11 @@ def main() -> None:
     # and the bounded store's residency evidence riding next to the
     # peak-RSS row — resident chunks held vs the evictions the budget
     # forced (the flat-in-N story needs both numbers together)
+    # ...and the storage-integrity tax riding the same probe: warm
+    # gather wall with the verify-on-read checksums on minus off —
+    # the ≈ 0 evidence that durability is not a throughput knob
     for key in ("prefetch_overlap_saved_s", "store_resident_chunks",
-                "store_evictions"):
+                "store_evictions", "checksum_overhead_s"):
         headline[key] = out.get("prefetch", {}).get(key)
     if "mxu_probe" in out:
         headline["mxu_pct_peak"] = out["mxu_probe"]["pct_peak"]

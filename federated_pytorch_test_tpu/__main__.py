@@ -118,6 +118,7 @@ init, so they run on any host):
 
     python -m federated_pytorch_test_tpu report runs/ --json report.json
     python -m federated_pytorch_test_tpu watch runs/ [--once] [--interval S]
+    python -m federated_pytorch_test_tpu scrub ckpt/ [--repair]
 
 `report` ingests a directory of `--metrics-stream` files (validating
 each header like resume does, refusing foreign streams), aligns the
@@ -127,7 +128,15 @@ run) as JSON and markdown — a codec/combiner/deadline sweep becomes one
 command; `--incidents` adds the cross-run incident-bundle table.
 `watch` tails the same streams through the same validated ingestion and
 renders a refreshing terminal dashboard — sparklines, health, comm,
-fleet counters, memory, incidents.
+fleet counters, memory, incidents. `scrub` (fault/scrub.py) walks a
+store/checkpoint directory, verifies every spilled-chunk checksum
+against its manifest, and reports (exit 1, naming each corrupt file) or
+`--repair`s via the store's ladder: adopt an intact prior chunk version,
+else drop the chunk so its rows re-initialize pristine. The storage
+fault axis itself rides the plan string — `storage=<p>:<bitrot|torn|
+ioerror|enospc>[:strength]` chaos-injects the store/checkpoint/stream
+byte paths, survived by checksum-verified reads with bounded retry
+(docs/FAULT.md §Storage-integrity axis).
 """
 
 from __future__ import annotations
@@ -220,6 +229,7 @@ def _print_summary(recorder, cfg) -> None:
         order = (
             "drops", "stragglers", "crashes", "corruptions",
             "deadline_misses", "capped_stalls", "churned", "quarantines",
+            "storage_faults",
         )
         print(
             "# faults injected: "
@@ -332,6 +342,14 @@ def main(argv=None) -> int:
         from federated_pytorch_test_tpu.obs.console import watch_main
 
         return watch_main(argv[1:])
+    if argv and argv[0] == "scrub":
+        # the storage-integrity verb (fault/scrub.py): walk a store /
+        # checkpoint dir, verify every chunk checksum, report or
+        # --repair — backend-free like report/watch, so a dead host's
+        # store can be scrubbed from anywhere
+        from federated_pytorch_test_tpu.fault.scrub import scrub_main
+
+        return scrub_main(argv[1:])
 
     from federated_pytorch_test_tpu.engine import (
         PRESETS,

@@ -43,12 +43,16 @@ they were unknown at launch. Scatter-before-next-gather ordering is
 therefore preserved *semantically*: the bytes adopted for any row a
 scatter touched are post-scatter bytes.
 
-**Failure rule.** A prefetch is an optimization, never a dependency: a
-worker exception is stashed and adoption falls back to the synchronous
-gather with a warning; a crash mid-prefetch just loses the daemon
-thread with the process, and the resumed run gathers cold — stream and
-store identity are untouched (the crash/resume contract rides the
-unchanged commit ordering).
+**Failure rule.** A prefetch is an optimization, never a dependency:
+transient I/O failures (OSError, checksum IntegrityError — flaky or
+chaos-injected disks, fault/io.py) get the bounded retry/backoff every
+disk-facing path shares BEFORE the worker gives up; a worker exception
+that survives it is stashed and adoption falls back to the synchronous
+gather with a warning that names the failing chunk file when the error
+carries one. A crash mid-prefetch just loses the daemon thread with
+the process, and the resumed run gathers cold — stream and store
+identity are untouched (the crash/resume contract rides the unchanged
+commit ordering).
 """
 
 from __future__ import annotations
@@ -58,6 +62,8 @@ import warnings
 from typing import Any, Callable, Optional
 
 import numpy as np
+
+from federated_pytorch_test_tpu.fault.io import IntegrityError, retry_io
 
 
 class CohortPrefetcher:
@@ -69,8 +75,16 @@ class CohortPrefetcher:
     thread lifecycle and the match-or-discard rule.
     """
 
-    def __init__(self, worker: Callable[[int, np.ndarray, np.ndarray], Any]):
+    def __init__(
+        self,
+        worker: Callable[[int, np.ndarray, np.ndarray], Any],
+        io_retries: int = 3,
+    ):
         self._worker = worker
+        # transient-I/O retry budget for one worker run (module
+        # docstring Failure rule); deterministic worker errors fail
+        # fast — only OSError/IntegrityError are worth a re-run
+        self._io_retries = int(io_retries)
         self._pending: Optional[dict] = None
 
     @property
@@ -93,7 +107,12 @@ class CohortPrefetcher:
 
         def run():
             try:
-                box["payload"] = self._worker(nloop, ids, known_dirty)
+                box["payload"] = retry_io(
+                    lambda: self._worker(nloop, ids, known_dirty),
+                    what=f"cohort prefetch worker (loop {nloop})",
+                    attempts=self._io_retries,
+                    retry_on=(OSError, IntegrityError),
+                )
             except BaseException as e:  # stash; adoption falls back
                 box["error"] = e
 
@@ -123,9 +142,14 @@ class CohortPrefetcher:
         p["thread"].join()
         err = p["box"]["error"]
         if err is not None:
+            detail = f"{type(err).__name__}: {err}"
+            if isinstance(err, IntegrityError) and err.path:
+                # the operator's first question is WHICH file — surface
+                # the chunk path even when the message got wrapped
+                detail += f" [chunk file: {err.path}]"
             warnings.warn(
                 f"cohort prefetch for loop {nloop} failed "
-                f"({type(err).__name__}: {err}); gathering synchronously"
+                f"({detail}); gathering synchronously"
             )
             return None
         return p["box"]["payload"]

@@ -71,27 +71,106 @@ scatter loop n's, save a checkpoint, or evict under the residency
 budget. One re-entrant lock serializes every public operation — the
 critical sections are O(C) row copies or one chunk's file I/O, so the
 background gather still overlaps all of the round's device compute.
+
+Storage integrity (docs/SCALE.md §Durability, docs/FAULT.md §Storage):
+with `checksums` on (the default) every chunk write stamps a digest
+(fault/io.py `checksum`) that is recorded in the manifest and verified
+on EVERY read — mmap or full — before any row can reach a gather, and
+the manifest itself carries a self-CRC. A failed verification retries
+(bounded, exponential backoff — transient rot/injected faults heal on a
+clean re-read), then walks the repair ladder: adopt the newest intact
+PRIOR version of the chunk (versions are never overwritten, so older
+snapshots survive); else re-initialize the chunk pristine by
+construction and count it (`repairs_reinit`, surfaced into the
+telemetry-weighting penalties); else — with `repair=False`, the strict
+resume/scrub stance — refuse loudly naming the chunk. Legacy v1
+manifests (no digests) restore read-only-accepted: their chunks simply
+go unverified until the next save rewrites them under v2. The optional
+`storage_io` shim (fault/io.py StorageFaultShim) routes every chunk
+read/write through the chaos schedule of the plan's `storage` axis.
 """
 
 from __future__ import annotations
 
 import ast
 import contextlib
+import io as _io
 import json
 import mmap
 import os
 import struct
 import threading
+import warnings
 import zipfile
 from typing import Dict, Optional
 
 import numpy as np
 
-_MANIFEST_VERSION = 1
+from federated_pytorch_test_tpu.fault.io import (
+    CHECKSUM_ALG,
+    IntegrityError,
+    checksum,
+    retry_io,
+    stamp_crc,
+    verify_crc,
+    verify_digest,
+)
+
+# version 2 adds per-chunk digests + the manifest self-CRC; version 1
+# (pre-integrity) manifests are still restorable — legacy chunks are
+# accepted read-only/unverified (module docstring)
+_MANIFEST_VERSION = 2
 
 
 def _manifest_path(root: str, step: int) -> str:
     return os.path.join(root, f"manifest_step_{step}.json")
+
+
+def _npz_views(buf, zf: zipfile.ZipFile) -> Dict[str, np.ndarray]:
+    """Read-only array views into an uncompressed `.npz`'s byte buffer.
+
+    np.savez STORES members uncompressed, so each `<name>.npy` payload
+    is a contiguous byte range of the archive: parse each member's
+    local header + npy header and view the payload in place — `buf` may
+    be an mmap (the zero-copy spilled-gather path) or a verified bytes
+    object (the checksummed/shimmed path). Raises on anything
+    unexpected; the wrappers below fall back to a full `np.load`.
+    """
+    out: Dict[str, np.ndarray] = {}
+    for info in zf.infolist():
+        if info.compress_type != zipfile.ZIP_STORED:
+            raise ValueError("compressed npz member")
+        if not info.filename.endswith(".npy"):
+            continue
+        ho = info.header_offset
+        # local file header: magic(4) .. name_len@26 extra_len@28
+        if buf[ho : ho + 4] != b"PK\x03\x04":
+            raise ValueError("unexpected local header")
+        name_len, extra_len = struct.unpack_from("<HH", buf, ho + 26)
+        o = ho + 30 + name_len + extra_len
+        if buf[o : o + 6] != b"\x93NUMPY":
+            raise ValueError("not an npy member")
+        major = buf[o + 6]
+        if major == 1:
+            (hlen,) = struct.unpack_from("<H", buf, o + 8)
+            data = o + 10 + hlen
+            header = bytes(buf[o + 10 : o + 10 + hlen])
+        else:
+            (hlen,) = struct.unpack_from("<I", buf, o + 8)
+            data = o + 12 + hlen
+            header = bytes(buf[o + 12 : o + 12 + hlen])
+        meta = ast.literal_eval(header.decode("latin1"))
+        if meta.get("fortran_order") or not isinstance(
+            meta.get("descr"), str
+        ):
+            raise ValueError("non-C-contiguous or structured npy")
+        dtype = np.dtype(meta["descr"])
+        shape = tuple(meta["shape"])
+        arr = np.ndarray(shape, dtype, buffer=buf, offset=data)
+        if arr.flags.writeable:
+            arr.flags.writeable = False
+        out[info.filename[:-4]] = arr
+    return out
 
 
 def _mmap_npz(path: str) -> Dict[str, np.ndarray]:
@@ -99,10 +178,8 @@ def _mmap_npz(path: str) -> Dict[str, np.ndarray]:
 
     `np.load(..., mmap_mode=...)` silently ignores the mode for zip
     archives (every member would be decompressed into RAM), which is
-    exactly the O(chunk) copy a spilled gather exists to avoid. np.savez
-    STORES members uncompressed, so each `<name>.npy` payload is a
-    contiguous byte range of the file: map the file once, parse each
-    member's local header + npy header, and view the payload in place.
+    exactly the O(chunk) copy a spilled gather exists to avoid: map the
+    file once and view each member's payload in place (`_npz_views`).
     A gather then copies only the rows it needs.
 
     Falls back to a full `np.load` read (same values, more RAM for the
@@ -113,44 +190,32 @@ def _mmap_npz(path: str) -> Dict[str, np.ndarray]:
     try:
         with open(path, "rb") as f:
             mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-        out: Dict[str, np.ndarray] = {}
         with zipfile.ZipFile(path) as zf:
-            for info in zf.infolist():
-                if info.compress_type != zipfile.ZIP_STORED:
-                    raise ValueError("compressed npz member")
-                if not info.filename.endswith(".npy"):
-                    continue
-                ho = info.header_offset
-                # local file header: magic(4) .. name_len@26 extra_len@28
-                if mm[ho : ho + 4] != b"PK\x03\x04":
-                    raise ValueError("unexpected local header")
-                name_len, extra_len = struct.unpack_from("<HH", mm, ho + 26)
-                o = ho + 30 + name_len + extra_len
-                if mm[o : o + 6] != b"\x93NUMPY":
-                    raise ValueError("not an npy member")
-                major = mm[o + 6]
-                if major == 1:
-                    (hlen,) = struct.unpack_from("<H", mm, o + 8)
-                    data = o + 10 + hlen
-                    header = bytes(mm[o + 10 : o + 10 + hlen])
-                else:
-                    (hlen,) = struct.unpack_from("<I", mm, o + 8)
-                    data = o + 12 + hlen
-                    header = bytes(mm[o + 12 : o + 12 + hlen])
-                meta = ast.literal_eval(header.decode("latin1"))
-                if meta.get("fortran_order") or not isinstance(
-                    meta.get("descr"), str
-                ):
-                    raise ValueError("non-C-contiguous or structured npy")
-                dtype = np.dtype(meta["descr"])
-                shape = tuple(meta["shape"])
-                arr = np.ndarray(shape, dtype, buffer=mm, offset=data)
-                arr.flags.writeable = False
-                out[info.filename[:-4]] = arr
-        return out
+            return _npz_views(mm, zf)
     except (OSError, ValueError, KeyError, SyntaxError, struct.error):
         with np.load(path) as z:
             return {k: z[k] for k in z.files}
+
+
+def _npz_from_bytes(data: bytes, path: str) -> Dict[str, np.ndarray]:
+    """`_mmap_npz`'s equivalent over an in-memory byte buffer (the
+    shimmed read path holds the — possibly chaos-corrupted — bytes, not
+    the file). Unparseable data raises `IntegrityError` naming the file:
+    by the time this runs the buffer either passed its checksum or has
+    none to check, so a parse failure IS corruption, and the caller's
+    retry/repair ladder must see it as such rather than a crash."""
+    try:
+        try:
+            with zipfile.ZipFile(_io.BytesIO(data)) as zf:
+                return _npz_views(data, zf)
+        except (ValueError, KeyError, SyntaxError, struct.error,
+                zipfile.BadZipFile):
+            with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+                return {k: z[k] for k in z.files}
+    except Exception as e:
+        raise IntegrityError(
+            f"cannot parse chunk file {path}: {e}", path=path
+        ) from e
 
 
 class ClientStore:
@@ -164,11 +229,22 @@ class ClientStore:
         chunk_clients: int = 256,
         resident_chunks: Optional[int] = None,
         spill_dir: Optional[str] = None,
+        checksums: bool = True,
+        storage_io=None,
+        io_retries: int = 3,
+        repair: bool = True,
     ):
         """`resident_chunks` bounds the chunks held in RAM (None = keep
         everything, the legacy behavior); eviction of a dirty chunk
         spills it under `spill_dir` (the same directory later `save`
-        calls must use — asserted there), so a budget REQUIRES one."""
+        calls must use — asserted there), so a budget REQUIRES one.
+
+        `checksums` stamps/verifies per-chunk digests (module
+        docstring); `storage_io` is an optional fault/io.py
+        StorageFaultShim routing chunk reads/writes through the storage
+        chaos axis; `io_retries` bounds the read/write retry;
+        `repair=False` makes an unrepairable chunk refuse loudly
+        (IntegrityError naming it) instead of re-initializing pristine."""
         if n_virtual < 1:
             raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
         if chunk_clients < 1:
@@ -226,6 +302,24 @@ class ClientStore:
         self.evictions = 0
         self.spill_bytes = 0
         self.spill_reads = 0
+        # storage integrity (module docstring): per-file digests the
+        # manifest records, the chaos shim, and the detect/heal/repair
+        # counters the `integrity` record + scrub report surface
+        self.checksums = bool(checksums)
+        self._io = storage_io
+        self.io_retries = int(io_retries)
+        self.repair = bool(repair)
+        self._digests: Dict[str, dict] = {}
+        self.verified_reads = 0
+        self.integrity_failures = 0
+        self.retry_heals = 0
+        self.repairs_prior = 0
+        self.repairs_reinit = 0
+        # per-virtual-client repair counts since the last drain
+        # (take_repaired): the trainer scatters them into the
+        # `telem/repairs` reliability field so telemetry weighting can
+        # demote clients whose rows were rebuilt
+        self._repaired: Dict[int, int] = {}
         # chunk-file versions some retained MANIFEST references: a
         # spill may delete the version it supersedes only when no
         # manifest names it (resume must reach every retained
@@ -358,7 +452,7 @@ class ClientStore:
                     else:
                         out[pos] = fill
                 elif cid in self._files:
-                    arrs = self._read_chunk(self._files[cid])
+                    arrs = self._read_chunk(cid)
                     if name in arrs:
                         out[pos] = arrs[name][rows]
                     else:
@@ -404,19 +498,245 @@ class ClientStore:
                 self._dirty.add(cid)
             self._ensure_budget()
 
-    def _read_chunk(self, fname: str) -> Dict[str, np.ndarray]:
-        """Read-only array views of one on-disk chunk version, through
-        the per-file cache (versions are immutable): one zip parse
-        serves every field of a gather batch. `spill_reads` counts the
-        cache MISSES — actual file opens."""
+    def _read_chunk(self, cid: int) -> Dict[str, np.ndarray]:
+        """Read-only array views of chunk `cid`'s current on-disk
+        version, through the per-file cache (versions are immutable):
+        one zip parse serves every field of a gather batch.
+        `spill_reads` counts the cache MISSES — actual file opens.
+        A read that fails verification past the retry walks the repair
+        ladder (`_repair_chunk`), which may re-point `_files[cid]` at a
+        prior version or delete the entry entirely (pristine re-init —
+        the returned dict is then empty and every field falls back to
+        its fill row)."""
+        fname = self._files[cid]
         arrs = self._mmap_cache.get(fname)
-        if arrs is None:
-            arrs = _mmap_npz(self._chunk_path(fname))
-            self.spill_reads += 1
-            self._mmap_cache[fname] = arrs
-            while len(self._mmap_cache) > self._mmap_cache_max:
-                self._mmap_cache.pop(next(iter(self._mmap_cache)))
+        if arrs is not None:
+            return arrs
+        self.spill_reads += 1
+        try:
+            arrs = self._load_verified(fname)
+        except (OSError, IntegrityError) as e:
+            return self._repair_chunk(cid, fname, e)
+        self._cache_views(fname, arrs)
         return arrs
+
+    def _cache_views(self, fname: str, arrs: Dict[str, np.ndarray]) -> None:
+        self._mmap_cache[fname] = arrs
+        while len(self._mmap_cache) > self._mmap_cache_max:
+            self._mmap_cache.pop(next(iter(self._mmap_cache)))
+
+    def _load_verified(self, fname: str) -> Dict[str, np.ndarray]:
+        """One chunk file -> array views, checksum-verified BEFORE any
+        row can reach a gather, with bounded retry (transient injected
+        faults — and real flaky disks — heal on a clean re-read, which
+        `retry_heals` counts). Raises OSError/IntegrityError when every
+        attempt fails; the caller decides repair vs refusal."""
+        path = self._chunk_path(fname)
+        digest = self._digests.get(fname) if self.checksums else None
+        if self._io is None and digest is None:
+            # fast path: no chaos shim, nothing to verify (checksums
+            # off, or a legacy/unmanifested version) — the pre-integrity
+            # zero-copy mmap read, bit for bit
+            return _mmap_npz(path)
+        fails = [0]
+
+        def attempt() -> Dict[str, np.ndarray]:
+            try:
+                if self._io is not None:
+                    data = self._io.read_bytes(path)
+                    if not verify_digest(data, digest):
+                        raise IntegrityError(
+                            f"client-store chunk {fname} failed checksum "
+                            f"verification at {path}",
+                            path=path,
+                        )
+                    if digest is not None:
+                        self.verified_reads += 1
+                    return _npz_from_bytes(data, path)
+                # no shim: verify over a throwaway mapping (page-cache
+                # warm for the view parse that follows)
+                with open(path, "rb") as f:
+                    mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                    try:
+                        ok = verify_digest(mm, digest)
+                    finally:
+                        mm.close()
+                if not ok:
+                    raise IntegrityError(
+                        f"client-store chunk {fname} failed checksum "
+                        f"verification at {path}",
+                        path=path,
+                    )
+                self.verified_reads += 1
+                return _mmap_npz(path)
+            except (OSError, IntegrityError) as e:
+                fails[0] += 1
+                if isinstance(e, IntegrityError):
+                    self.integrity_failures += 1
+                raise
+
+        out = retry_io(
+            attempt,
+            what=f"client-store chunk read ({fname})",
+            attempts=self.io_retries,
+            retry_on=(OSError, IntegrityError),
+        )
+        if fails[0]:
+            self.retry_heals += 1
+        return out
+
+    def _retained_digests(self, root: str) -> Dict[str, dict]:
+        """Chunk digests every retained manifest records (the repair
+        ladder verifies PRIOR versions against the manifest that
+        committed them, not just the live map's digests)."""
+        out: Dict[str, dict] = {}
+        try:
+            entries = sorted(os.listdir(root))
+        except OSError:
+            return out
+        for entry in entries:
+            if not (
+                entry.startswith("manifest_step_")
+                and entry.endswith(".json")
+            ):
+                continue
+            try:
+                with open(os.path.join(root, entry)) as f:
+                    out.update(json.load(f).get("digests", {}))
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def _repair_chunk(
+        self, cid: int, fname: str, err: Exception
+    ) -> Dict[str, np.ndarray]:
+        """The repair ladder for a chunk whose current version failed
+        past the retry (module docstring): newest intact prior version;
+        else pristine re-init by construction, counted; else — repair
+        disabled — refuse loudly naming the chunk."""
+        root = self._root(self._save_dir)
+        if not self.repair:
+            raise IntegrityError(
+                f"client-store chunk {fname} is corrupt and repair is "
+                f"disabled: {err}",
+                path=self._chunk_path(fname),
+            )
+        for f, d in self._retained_digests(root).items():
+            self._digests.setdefault(f, d)
+        prefix = f"chunk_{cid:06d}_v"
+        try:
+            priors = sorted(
+                (
+                    e
+                    for e in os.listdir(root)
+                    if e.startswith(prefix)
+                    and e.endswith(".npz")
+                    and e != fname
+                ),
+                reverse=True,  # newest version first
+            )
+        except OSError:
+            priors = []
+        for prior in priors:
+            try:
+                arrs = self._load_verified(prior)
+            except (OSError, IntegrityError):
+                continue
+            self._files[cid] = prior
+            self.repairs_prior += 1
+            self._count_repairs(cid)
+            self._cache_views(prior, arrs)
+            warnings.warn(
+                f"client-store chunk {cid} repaired: adopted prior "
+                f"intact version {prior} (current {fname} failed: {err})"
+            )
+            return arrs
+        # no intact version anywhere: the chunk reverts to pristine —
+        # correct BY CONSTRUCTION (every field falls back to its
+        # registered fill row, the same state a never-touched chunk
+        # holds) — and the loss is counted, per-client, for the
+        # telemetry penalties
+        del self._files[cid]
+        self._dirty.discard(cid)
+        self.repairs_reinit += 1
+        self._count_repairs(cid)
+        warnings.warn(
+            f"client-store chunk {cid} has no intact version "
+            f"(current {fname} failed: {err}); re-initialized pristine"
+        )
+        return {}
+
+    def _count_repairs(self, cid: int) -> None:
+        lo = cid * self.chunk_clients
+        for vid in range(lo, lo + self._chunk_rows(cid)):
+            self._repaired[vid] = self._repaired.get(vid, 0) + 1
+
+    def take_repaired(self) -> Dict[int, int]:
+        """Drain the per-client repair counts accumulated since the
+        last call (`{vid: repairs}`) — the trainer folds them into the
+        `telem/repairs` reliability field each loop."""
+        with self._lock:
+            out = self._repaired
+            self._repaired = {}
+            return out
+
+    def verify_all(self) -> dict:
+        """Verify every manifest-referenced chunk file's checksum —
+        no adoption, no repair: the resume-time gate (and scrub's
+        report pass). Raises IntegrityError naming the first chunk that
+        fails past the retry; legacy files without a digest are skipped
+        (read-only accepted by the format contract). Returns
+        `{"verified": n, "chunks": total}`."""
+        with self._lock:
+            checked = 0
+            for cid in sorted(self._files):
+                fname = self._files[cid]
+                digest = (
+                    self._digests.get(fname) if self.checksums else None
+                )
+                if digest is None:
+                    continue
+                path = self._chunk_path(fname)
+
+                def attempt(path=path, fname=fname, digest=digest):
+                    if self._io is not None:
+                        data = self._io.read_bytes(path)
+                    else:
+                        with open(path, "rb") as f:
+                            data = f.read()
+                    if not verify_digest(data, digest):
+                        self.integrity_failures += 1
+                        raise IntegrityError(
+                            f"client-store chunk {fname} failed checksum "
+                            f"verification at {path}",
+                            path=path,
+                        )
+
+                retry_io(
+                    attempt,
+                    what=f"client-store chunk verify ({fname})",
+                    attempts=self.io_retries,
+                    retry_on=(OSError, IntegrityError),
+                )
+                self.verified_reads += 1
+                checked += 1
+            return {"verified": checked, "chunks": len(self._files)}
+
+    def integrity_digest(self) -> dict:
+        """The small integrity digest the trainer logs as the
+        `integrity` record and stamps into the status sidecar
+        (docs/OBSERVABILITY.md): checksum config + the
+        detect/heal/repair counters."""
+        with self._lock:
+            return {
+                "checksums": self.checksums,
+                "alg": CHECKSUM_ALG,
+                "verified_reads": int(self.verified_reads),
+                "failures": int(self.integrity_failures),
+                "retry_heals": int(self.retry_heals),
+                "repairs_prior": int(self.repairs_prior),
+                "repairs_reinit": int(self.repairs_reinit),
+            }
 
     def _materialize(self, cid: int) -> Dict[str, np.ndarray]:
         """Bring chunk `cid` into the resident set for writing: a full
@@ -424,8 +744,8 @@ class ClientStore:
         empty dict whose fields fill lazily."""
         if cid in self._files:
             chunk = {
-                k: np.array(v)  # writable copies off the shared mmap
-                for k, v in self._read_chunk(self._files[cid]).items()
+                k: np.array(v)  # writable copies off the shared views
+                for k, v in self._read_chunk(cid).items()
             }
         else:
             chunk = {}
@@ -518,20 +838,39 @@ class ClientStore:
         """One chunk -> its next versioned `.npz` (tmp+fsync+rename);
         updates `_files` and returns the bytes written. THE one chunk
         writer — `save` and the dirty-spill eviction share it, so the
-        on-disk format and the GC's filename rules cannot drift."""
+        on-disk format and the GC's filename rules cannot drift. The
+        payload is serialized once up front so its digest covers
+        exactly the bytes that land, and transient write faults
+        (injected ioerror/enospc, real flaky disks) are absorbed by the
+        bounded retry — the chaos shim refuses BEFORE any bytes move,
+        so a retried write never half-lands."""
         root = self._root(directory)
         os.makedirs(root, exist_ok=True)
         self._seq += 1
         fname = f"chunk_{cid:06d}_v{self._seq:08d}.npz"
         tmp = os.path.join(root, f".tmp_{fname}")
-        with open(tmp, "wb") as f:
-            np.savez(f, **self._chunks[cid])
-            f.flush()
-            os.fsync(f.fileno())
-        nbytes = os.path.getsize(tmp)
+        buf = _io.BytesIO()
+        np.savez(buf, **self._chunks[cid])
+        payload = buf.getvalue()
+
+        def write():
+            if self._io is not None:
+                self._io.before_write(f"client-store chunk {fname}")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+
+        retry_io(
+            write,
+            what=f"client-store chunk write ({fname})",
+            attempts=self.io_retries,
+        )
         os.replace(tmp, os.path.join(root, fname))
+        if self.checksums:
+            self._digests[fname] = checksum(payload)
         self._files[cid] = fname
-        return int(nbytes)
+        return len(payload)
 
     # --------------------------------------------------------- checkpointing
 
@@ -593,13 +932,38 @@ class ClientStore:
                     }
                     for name, row in sorted(self._fills.items())
                 },
+                # per-chunk-file digests, verified on every read before
+                # a row can reach a gather (module docstring); a file
+                # without one (checksums off when it was written) stays
+                # read-only accepted like a v1 legacy chunk
+                "digests": {
+                    f: self._digests[f]
+                    for f in sorted(set(self._files.values()))
+                    if f in self._digests
+                },
             }
+            # the manifest carries its own CRC (fault/io.py stamp_crc):
+            # a bit-rotted-but-parsable manifest must not restore —
+            # it indexes every chunk of the snapshot
+            text = stamp_crc(manifest)
             path = _manifest_path(root, step)
             tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-                f.flush()
-                os.fsync(f.fileno())
+
+            def write_manifest():
+                if self._io is not None:
+                    self._io.before_write(
+                        f"client-store manifest step {step}"
+                    )
+                with open(tmp, "w") as f:
+                    f.write(text)
+                    f.flush()
+                    os.fsync(f.fileno())
+
+            retry_io(
+                write_manifest,
+                what=f"client-store manifest write (step {step})",
+                attempts=self.io_retries,
+            )
             os.replace(tmp, path)
             self._gc(root)
             self._ensure_budget()
@@ -655,6 +1019,9 @@ class ClientStore:
         # manifest's versions (resume reaches any of those snapshots)
         self._protected = set(manifest_refs)
         referenced = manifest_refs | set(self._files.values())
+        self._digests = {
+            f: d for f, d in self._digests.items() if f in referenced
+        }
         for entry in os.listdir(root):
             stale = entry.startswith("chunk_") and entry not in referenced
             if stale or entry.startswith(".tmp_") or entry.endswith(
@@ -699,11 +1066,22 @@ class ClientStore:
                 )
             with open(path) as f:
                 manifest = json.load(f)
-            if manifest.get("version") != _MANIFEST_VERSION:
+            version = manifest.get("version")
+            if version not in (1, _MANIFEST_VERSION):
                 raise ValueError(
                     f"client-store manifest version "
-                    f"{manifest.get('version')} != supported "
+                    f"{version} != supported "
                     f"{_MANIFEST_VERSION}"
+                )
+            if version >= 2 and not verify_crc(manifest):
+                # a v2 manifest ALWAYS carries a self-CRC; a parsable
+                # document that fails it is bit rot, and it indexes the
+                # whole snapshot — refuse so the trainer's restore loop
+                # falls back to the previous intact checkpoint
+                raise IntegrityError(
+                    f"client-store manifest for step {step} failed its "
+                    f"self-checksum at {path}",
+                    path=path,
                 )
             for key, mine in (
                 ("n_virtual", self.n_virtual),
@@ -748,6 +1126,10 @@ class ClientStore:
             self._dirty.clear()
             self._mmap_cache.clear()
             self._files = files
+            # v1 manifests carry no digests: their chunks restore
+            # read-only accepted/unverified until the next save rewrites
+            # them under v2 (the legacy-migration path, docs/SCALE.md)
+            self._digests = dict(manifest.get("digests", {}))
             # conservative: this manifest's versions are committed (and
             # a sibling retained manifest may reference more — the next
             # save's GC scan refines the set); spills must not delete

@@ -137,6 +137,15 @@ class ExperimentConfig:
     # the bitwise fallback); a dispatch-shape-only knob like fold_eval,
     # excluded from the metric-stream tag.
     prefetch: bool = True
+    # crc32 checksums on every spilled/checkpointed store chunk file and
+    # manifest (clients/store.py, fault/io.py): stamped at write, verified
+    # on every spill read BEFORE a row can reach a gather, with the
+    # three-step repair ladder behind detection (docs/FAULT.md §Storage-
+    # integrity axis). Off = legacy byte path (chunks written without
+    # digests are still readable by checksumming runs — the v1-accepted
+    # format contract). A durability knob, not a trajectory knob:
+    # excluded from the metric-stream tag like prefetch.
+    store_checksums: bool = True
 
     # loop nest sizes (reference src/federated_trio.py:20-22)
     nloop: int = 12  # outer loops over the partition groups

@@ -39,6 +39,7 @@ import hashlib
 import json
 import os
 import time
+import warnings
 from typing import Any, Dict, Optional
 
 import jax
@@ -71,7 +72,9 @@ from federated_pytorch_test_tpu.engine.steps import (
 from federated_pytorch_test_tpu.fault import (
     FaultInjector,
     FaultPlan,
+    IntegrityError,
     step_budgets,
+    storage_shim_for,
 )
 from federated_pytorch_test_tpu.models import MODELS
 from federated_pytorch_test_tpu.obs import (
@@ -109,6 +112,7 @@ from federated_pytorch_test_tpu.utils import (
     load_checkpoint,
     save_checkpoint,
 )
+from federated_pytorch_test_tpu.utils.checkpoint import _list_steps
 
 PyTree = Any
 
@@ -227,6 +231,19 @@ class Trainer:
         # registered lazily at each group's first scatter. Stats leaves
         # are addressed by tree path in canonical flatten order, the same
         # order `jax.tree.leaves(self.stats)` yields at scatter time.
+        # storage-integrity plumbing (fault/io.py, docs/FAULT.md
+        # §Storage-integrity axis): the plan is parsed ONCE here and the
+        # one shim instance (None without a storage axis) is handed to
+        # every disk-facing byte path — client store, checkpoint writer,
+        # metric stream — plus the injector, whose scoreboard counts the
+        # faults the shim actually fired
+        self._fault_plan = (
+            FaultPlan.parse(cfg.fault_plan) if cfg.fault_plan else None
+        )
+        self._storage_shim = (
+            storage_shim_for(self._fault_plan) if self._fault_plan else None
+        )
+
         self.store = None
         self.sampler = None
         self._prefetch = None
@@ -265,6 +282,11 @@ class Trainer:
                     if cfg.store_resident_chunks is not None
                     else None
                 ),
+                # storage integrity (docs/FAULT.md §Storage-integrity
+                # axis): checksum every spilled chunk + manifest, verify
+                # before rows reach a gather, repair through the ladder
+                checksums=cfg.store_checksums,
+                storage_io=self._storage_shim,
             )
             self.store.register_field("flat", np.asarray(flat0))
             stats_leaves, self._stats_def = jax.tree_util.tree_flatten(stats)
@@ -494,7 +516,7 @@ class Trainer:
         self.injector = None
         if cfg.fault_plan:
             self.injector = FaultInjector(
-                FaultPlan.parse(cfg.fault_plan),
+                self._fault_plan,
                 # cohort mode keys every schedule by VIRTUAL client id:
                 # the plan draws [N] rows and the trainer gathers the
                 # cohort's columns (_vslice), so a client's fault
@@ -504,6 +526,9 @@ class Trainer:
                 # crash sentinels live with the checkpoints they recover
                 # from; without checkpointing the record is process-local
                 state_dir=cfg.checkpoint_dir if cfg.save_model else None,
+                # the storage shim built above: its injected-fault count
+                # joins the end-of-run scoreboard
+                storage=self._storage_shim,
             )
             if self.injector.has_churn:
                 if not self._cohort_mode:
@@ -573,7 +598,11 @@ class Trainer:
             # single-writer like the checkpoints: on a multi-process mesh
             # every process records identical series (metrics come off
             # allgathered values), so process 0's stream is THE stream
-            sink = JsonlSink(cfg.metrics_stream, tag=self._stream_tag())
+            sink = JsonlSink(
+                cfg.metrics_stream,
+                tag=self._stream_tag(),
+                storage_io=self._storage_shim,
+            )
             replay = sink.open(
                 resume_nloops=self._completed_nloops
                 if cfg.resume == "auto"
@@ -614,6 +643,9 @@ class Trainer:
         # under a jax.profiler window, bounded per process
         self._profile_pending = False
         self._profile_captures = 0
+        # storage_fault incident rising edge: detections + repairs the
+        # store has surfaced that a previous round already reported
+        self._storage_fault_seen = 0
         # live status sidecar for the `watch` console (obs/console.py):
         # memory and the current cursor are process facts that never
         # enter the stream, so they surface through this atomically
@@ -806,14 +838,17 @@ class Trainer:
         # bit-identical to a cold one — tests/test_prefetch.py) and
         # `store_resident_chunks` a memory-shape one (residency never
         # changes a gathered byte): a resumed run may flip either and
-        # still splice.
+        # still splice. `store_checksums` is a durability knob on the
+        # same byte path — verified reads return the same bytes
+        # unverified ones would (tests/test_integrity.py), so a resumed
+        # run may flip it and still splice.
         for k in (
             "metrics_stream", "trace_out", "profile_dir", "resume",
             "compile_cache", "fold_eval", "async_eval",
             "health_monitor", "health_window",
             "flight_recorder", "flight_window", "memory_telemetry",
             "profile_on_anomaly", "profile_budget",
-            "prefetch", "store_resident_chunks",
+            "prefetch", "store_resident_chunks", "store_checksums",
         ):
             d.pop(k, None)
         cfg_tag = hashlib.md5(
@@ -1164,6 +1199,7 @@ class Trainer:
         "telem/misses",       # deadline misses (budget < lockstep steps)
         "telem/drops",        # plan dropouts while sampled
         "telem/quarantines",  # times the defense flagged the client
+        "telem/repairs",      # rows the integrity ladder had to repair
     )
 
     def _telemetry_weights(self) -> np.ndarray:
@@ -1190,9 +1226,16 @@ class Trainer:
         quar = self.store.gather(
             "telem/quarantines", ids
         ).astype(np.float64)
+        # integrity repairs (docs/FAULT.md §Storage-integrity axis): a
+        # client whose rows the ladder re-initialized carries a wiped,
+        # untrustworthy history — penalize it like a miss so the sampler
+        # leans on clients whose state is verified-intact. Zero on every
+        # healthy run (retry-healed reads never count), so the weights —
+        # and the trajectory — are unchanged unless data was truly lost.
+        rep = self.store.gather("telem/repairs", ids).astype(np.float64)
         n = np.maximum(ex, 1.0)
         speed = np.where(ex > 0, sp / n, 1.0)
-        penalty = (miss + drops + quar) / n
+        penalty = (miss + drops + quar + rep) / n
         return 1.0 / (speed * (1.0 + penalty))
 
     def _pool_availability(self, nloop: int):
@@ -1252,6 +1295,18 @@ class Trainer:
         for name, delta in updates.items():
             cur = self.store.gather(name, ids)
             self.store.scatter(name, ids, cur + delta)
+        # repairs drain OUTSIDE the cohort: the ladder can fire on any
+        # chunk a gather touched (telemetry weights read all N clients),
+        # so the drained per-client counts are scattered wherever they
+        # landed, not just into this loop's cohort rows
+        repaired = self.store.take_repaired()
+        if repaired:
+            rids = np.asarray(sorted(repaired), np.int64)
+            delta = np.asarray(
+                [repaired[int(v)] for v in rids], np.float32
+            )
+            cur = self.store.gather("telem/repairs", rids)
+            self.store.scatter("telem/repairs", rids, cur + delta)
 
     def _state_field_names(self) -> list:
         """Every store field the cohort gather assembles into device
@@ -2295,6 +2350,23 @@ class Trainer:
         if self.recorder.tracer is not None:
             self.recorder.tracer.counter("dispatches", self._dispatch.counts)
         self.recorder.flush()
+        if self.store is not None:
+            # storage_fault incident (docs/FAULT.md §Storage-integrity
+            # axis): a round in which the store DETECTED corruption or
+            # ran the repair ladder joins the anomaly path — the flight
+            # recorder dumps a forensics bundle (rising-edge deduped
+            # like any health anomaly). Retry-healed reads count as
+            # detections here: the operator wants the bundle while the
+            # flaky disk is still flaky.
+            dig = self.store.integrity_digest()
+            seen = (
+                int(dig["failures"])
+                + int(dig["repairs_prior"])
+                + int(dig["repairs_reinit"])
+            )
+            if seen > self._storage_fault_seen:
+                anomalies = list(anomalies) + ["storage_fault"]
+            self._storage_fault_seen = seen
         if anomalies:
             if self.cfg.profile_on_anomaly:
                 # capture the NEXT round (this one already ran)
@@ -2417,6 +2489,12 @@ class Trainer:
             # live store residency for `watch` (and the spill smoke's
             # RSS-ceiling read rides the sidecar's memory block)
             doc["store"] = self.store.residency()
+            # live integrity digest (verified reads / failures / repair
+            # ladder counts) — process facts like residency, surfaced
+            # here and via `report --integrity`, never in the stream
+            doc["integrity"] = self.store.integrity_digest()
+        if self._storage_shim is not None:
+            doc["storage_faults"] = int(self._storage_shim.injected)
         tmp = self._status_path + ".tmp"
         try:
             with open(tmp, "w") as f:
@@ -3190,6 +3268,9 @@ class Trainer:
                 # finished run's `watch` panel should show where the
                 # store actually ended up
                 doc["store"] = self.store.residency()
+                doc["integrity"] = self.store.integrity_digest()
+            if self._storage_shim is not None:
+                doc["storage_faults"] = int(self._storage_shim.injected)
             tmp = self._status_path + ".tmp"
             try:
                 with open(tmp, "w") as f:
@@ -3356,6 +3437,14 @@ class Trainer:
             self.recorder.log(
                 "store_summary", self.store.summary(), stream=False
             )
+            # storage-integrity digest (clients/store.py): verified
+            # reads / failures / heals / repairs are process facts for
+            # the same reason — a resumed run's counts cover its own
+            # reads only — so stream=False; `report --integrity` and
+            # the status sidecar are their surfaces
+            self.recorder.log(
+                "integrity", self.store.integrity_digest(), stream=False
+            )
         return self.recorder
 
     # ----------------------------------------------------------- checkpoint
@@ -3434,13 +3523,53 @@ class Trainer:
             from jax.experimental import multihost_utils
 
             if jax.process_index() == 0:
-                save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
+                save_checkpoint(
+                    self.cfg.checkpoint_dir, state, step=step,
+                    storage_io=self._storage_shim,
+                )
             multihost_utils.sync_global_devices(f"checkpoint_step_{step}")
             return path
-        return save_checkpoint(self.cfg.checkpoint_dir, state, step=step)
+        return save_checkpoint(
+            self.cfg.checkpoint_dir, state, step=step,
+            storage_io=self._storage_shim,
+        )
 
     def _restore(self) -> None:
-        state = load_checkpoint(self.cfg.checkpoint_dir)
+        """Restore from the newest checkpoint whose FULL state — orbax
+        tree AND (cohort mode) client-store snapshot — actually loads
+        and verifies. A corrupt store manifest, or a chunk that fails
+        checksum verification past the repair ladder (IntegrityError),
+        disqualifies that step exactly like a torn orbax tree does:
+        fall back to the next-newest instead of wedging the resume."""
+        root = os.path.abspath(self.cfg.checkpoint_dir)
+        steps = _list_steps(root)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {root}")
+        for s in reversed(steps):
+            try:
+                state = load_checkpoint(self.cfg.checkpoint_dir, step=s)
+            except Exception as e:
+                warnings.warn(
+                    f"skipping unreadable checkpoint step {s}: "
+                    f"{type(e).__name__}: {e}; falling back to the "
+                    "next-newest"
+                )
+                continue
+            try:
+                self._apply_restore(state)
+                return
+            except (FileNotFoundError, IntegrityError) as e:
+                warnings.warn(
+                    f"checkpoint step {s} loads but its client-store "
+                    f"snapshot is unusable ({e}); falling back to the "
+                    "next-newest"
+                )
+                continue
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {root} (tried steps {steps})"
+        )
+
+    def _apply_restore(self, state) -> None:
         csh = client_sharding(self.mesh)
         # _owned_copy: flat/stats flow into the epoch fn's donated slots;
         # they must not remain zero-copy views of the (soon-freed)
@@ -3461,11 +3590,28 @@ class Trainer:
                     "and attention would be silently scrambled — re-train "
                     "or convert the checkpoint"
                 )
+        # cleared before refill: a failed newer-step attempt must not
+        # leak per-group entries an older checkpoint does not carry
+        self._rho_store.clear()
+        self._ef_store.clear()
         for g, r in state.get("rho_store", {}).items():
             self._rho_store[int(g)] = _owned_copy(self._put(r, csh))
         for g, e in state.get("ef_store", {}).items():
             self._ef_store[int(g)] = _owned_copy(self._put(e, csh))
         if self._cohort_mode:
+            # the store snapshot committed WITH this checkpoint (its
+            # manifest step is the restored loop cursor — Trainer.save
+            # writes both under the same step). Loaded and VERIFIED
+            # first — a manifest or chunk that fails its checksum raises
+            # IntegrityError here, before any sampler history is seeded,
+            # so _restore can fall back to the previous step cleanly.
+            self.store.load(
+                self.cfg.checkpoint_dir, step=self._completed_nloops
+            )
+            if self.cfg.store_checksums:
+                # resume-time gate: every manifest-referenced chunk's
+                # bytes verify BEFORE the run adopts this snapshot
+                self.store.verify_all()
             hist = state.get("cohort_history")
             if hist is not None:
                 # seed the sampler's draw history with the completed
@@ -3478,16 +3624,10 @@ class Trainer:
                 for l in range(min(int(hist.shape[0]),
                                    self._completed_nloops)):
                     self.sampler.seed_history(l, hist[l])
-            # the store snapshot committed WITH this checkpoint (its
-            # manifest step is the restored loop cursor — Trainer.save
-            # writes both under the same step). Lazily-registered rho
-            # fields the crashed run had scattered are re-registered from
-            # the manifest's recorded shapes with the init-rho fill, so
-            # restored chunks stay addressable before the group's first
-            # round of the resumed run.
-            self.store.load(
-                self.cfg.checkpoint_dir, step=self._completed_nloops
-            )
+            # Lazily-registered rho fields the crashed run had scattered
+            # are re-registered from the manifest's recorded shapes with
+            # the init-rho fill, so restored chunks stay addressable
+            # before the group's first round of the resumed run.
             for name, meta in self.store.saved_fields.items():
                 if name.startswith("rho/") and not self.store.has_field(name):
                     self.store.register_field(

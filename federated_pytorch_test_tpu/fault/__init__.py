@@ -12,7 +12,11 @@ The subsystem has three parts, stitched into the engine by `Trainer`:
 * participation-masked aggregation lives with the consensus math it
   guards (consensus/fedavg.py, consensus/admm.py — the `mask` argument),
   and the Byzantine-robust combiners + auto-quarantine that defend
-  against corruption live in consensus/robust.py.
+  against corruption live in consensus/robust.py;
+* the STORAGE axis (io.py) — checksums, the fault-pluggable I/O shim
+  the ClientStore/checkpoint/stream byte paths route through, and the
+  bounded disk retry; scrub.py is the engine-import-free `scrub` CLI
+  verb that walks a store/checkpoint dir verifying and repairing.
 
 See docs/FAULT.md for the replay/resume guarantees.
 """
@@ -21,9 +25,21 @@ from federated_pytorch_test_tpu.fault.injector import (
     FaultInjector,
     step_budgets,
 )
+from federated_pytorch_test_tpu.fault.io import (
+    CHECKSUM_ALG,
+    IntegrityError,
+    StorageFaultShim,
+    checksum,
+    retry_io,
+    stamp_crc,
+    storage_shim_for,
+    verify_crc,
+    verify_digest,
+)
 from federated_pytorch_test_tpu.fault.plan import (
     CORRUPT_MODES,
     SEED_FOLDS,
+    STORAGE_MODES,
     CrashPoint,
     FaultPlan,
     InjectedCrash,
@@ -31,12 +47,22 @@ from federated_pytorch_test_tpu.fault.plan import (
 )
 
 __all__ = [
+    "CHECKSUM_ALG",
     "CORRUPT_MODES",
     "SEED_FOLDS",
+    "STORAGE_MODES",
     "CrashPoint",
     "FaultInjector",
     "FaultPlan",
     "InjectedCrash",
+    "IntegrityError",
+    "StorageFaultShim",
+    "checksum",
     "fold_seed",
+    "retry_io",
+    "stamp_crc",
     "step_budgets",
+    "storage_shim_for",
+    "verify_crc",
+    "verify_digest",
 ]

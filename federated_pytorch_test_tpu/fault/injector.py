@@ -57,10 +57,20 @@ class FaultInjector:
     """Per-run fault dispenser for one `FaultPlan`."""
 
     def __init__(
-        self, plan: FaultPlan, n_clients: int, state_dir: Optional[str] = None
+        self,
+        plan: FaultPlan,
+        n_clients: int,
+        state_dir: Optional[str] = None,
+        storage=None,
     ):
         self.plan = plan
         self.n_clients = n_clients
+        # the storage-axis shim (fault/io.py StorageFaultShim), when the
+        # plan schedules one: the trainer builds it once and hands the
+        # SAME instance to the ClientStore, the metrics sink, and this
+        # injector — the injector only reads its `injected` counter for
+        # the scoreboard (`storage_faults=`)
+        self.storage = storage
         if plan.corrupt_k > n_clients:
             # the plan alone cannot know K; validated here, where it
             # meets the run — silently capping would corrupt EVERY
@@ -326,6 +336,12 @@ class FaultInjector:
             counts["capped_stalls"] = capped_stalls
         if self.plan.has_churn:
             counts["churned"] = churned
+        if self.storage is not None:
+            # unlike every row above this one is NOT pure in the plan:
+            # which I/O ops exist depends on cache/residency state, so a
+            # resumed run reports the injections of ITS OWN process (the
+            # per-op draws are still deterministic — fault/io.py)
+            counts["storage_faults"] = int(self.storage.injected)
         return counts
 
     def straggler_delays_for_round(

@@ -1,0 +1,284 @@
+"""Storage-integrity primitives: checksums, the fault-pluggable I/O shim,
+and the bounded retry the disk-facing paths share.
+
+PR 15 put the disk in the training data path — spilled chunk files,
+versioned manifests, the metrics stream — but every fault axis so far
+watches the *wire*. This module is the storage counterpart, in three
+parts:
+
+* **Checksums.** `checksum(data)` stamps a small digest dict
+  (`{"alg", "crc", "size"}`) over a byte buffer; `verify_digest` checks
+  one, following the algorithm THE DIGEST declares (crc32c when the
+  native library is importable, stdlib crc32 — zlib's C implementation —
+  otherwise; a digest written under an algorithm this host cannot
+  compute is accepted with a one-time warning rather than bricking a
+  cross-host restore). `stamp_crc`/`verify_crc` are the JSON-document
+  face of the same idea: a `"crc"` field spliced into the serialized
+  object, covering every OTHER field — the per-line stream checksum
+  (obs/sinks.py STREAM_VERSION 2) and the store-manifest self-check
+  (clients/store.py) share this one definition, so the two formats
+  cannot drift. Document CRCs are pinned to stdlib crc32: they are part
+  of the versioned formats, not host-dependent.
+
+* **The fault shim.** `StorageFaultShim` injects the `storage` axis of a
+  `FaultPlan` (fault/plan.py: `storage=<p>:<mode>[:strength]`) into the
+  byte paths that opt in: the ClientStore's chunk reads/writes and the
+  metrics sink's line writes. `bitrot` flips `strength` bits in a read
+  buffer and `torn` truncates it — READ-side faults (disk rot manifests
+  at read time; the file itself stays intact, so a verified re-read
+  heals and the trajectory is untouched). `ioerror`/`enospc` raise
+  transient OSErrors on reads and/or writes, absorbed by the bounded
+  retry below. Each decision draws from
+  `default_rng([fold_seed(seed, "storage"), direction, op_ordinal])` —
+  deterministic given the op sequence, independent of every other axis'
+  draws — and the shim counts what it injected for the `# faults
+  injected:` scoreboard (`storage_faults=`). Unlike the pure-in-plan
+  axes the count is process-local: which ops exist depends on cache and
+  residency state, so a resumed run reports its own process' injections.
+
+* **Retry.** `retry_io` is the PR-1 multihost retry shape
+  (parallel/multihost.py initialize_distributed) for disk I/O: bounded
+  attempts, `backoff_s * 2**attempt` sleeps capped at 30 s, a warning
+  per failed attempt, and the LAST error re-raised loudly when every
+  attempt fails.
+
+`IntegrityError` is the loud refusal: raised when a checksum mismatch
+survives the retry and the caller has no repair left, always naming the
+file so the operator can `scrub` (fault/scrub.py) or delete it.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import threading
+import time
+import warnings
+import zlib
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from federated_pytorch_test_tpu.fault.plan import FaultPlan, fold_seed
+
+# ---------------------------------------------------------------- checksums
+
+# buffer-digest algorithms this host can compute. crc32c is the industry
+# storage checksum (and what real chunk stores stamp); the pure-stdlib
+# fallback is zlib's C crc32 — same 32-bit detection strength for the
+# single-bit-flip/truncation faults this layer defends against.
+_ALGS = {"crc32": zlib.crc32}
+try:  # pragma: no cover - absent from the CI image
+    from crc32c import crc32c as _crc32c
+
+    _ALGS["crc32c"] = _crc32c
+    CHECKSUM_ALG = "crc32c"
+except ImportError:
+    CHECKSUM_ALG = "crc32"
+
+_warned_algs: set = set()
+
+
+class IntegrityError(RuntimeError):
+    """A checksum mismatch no retry or repair could resolve; `path`
+    names the offending file (the repair ladder and `scrub` key on it)."""
+
+    def __init__(self, message: str, path: str = ""):
+        super().__init__(message)
+        self.path = path
+
+
+def crc_hex(data) -> str:
+    """Lower-hex crc32 of a byte buffer (bytes/bytearray/memoryview/mmap)."""
+    return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
+def checksum(data) -> dict:
+    """Digest dict for a byte buffer: `{"alg", "crc", "size"}`.
+
+    `alg` records WHICH checksum was computed so verification follows
+    the digest, not the verifying host's preference — a chunk written
+    where native crc32c was available still verifies on a host without
+    it (and vice versa, with a warning).
+    """
+    fn = _ALGS[CHECKSUM_ALG]
+    return {
+        "alg": CHECKSUM_ALG,
+        "crc": f"{fn(data) & 0xFFFFFFFF:08x}",
+        "size": int(len(data)),
+    }
+
+
+def verify_digest(data, digest: Optional[dict]) -> bool:
+    """True when `data` matches `digest` (None = nothing to check: a
+    legacy pre-checksum file, accepted read-only by construction)."""
+    if digest is None:
+        return True
+    alg = digest.get("alg")
+    fn = _ALGS.get(alg)
+    if fn is None:
+        # written under an algorithm this host cannot compute: accept
+        # like a legacy file rather than refusing a cross-host restore,
+        # but say so once — the operator is running unverified
+        if alg not in _warned_algs:
+            _warned_algs.add(alg)
+            warnings.warn(
+                f"cannot verify {alg!r} checksums on this host (no "
+                "implementation available); accepting unverified"
+            )
+        return True
+    if digest.get("size") is not None and int(digest["size"]) != len(data):
+        return False
+    return f"{fn(data) & 0xFFFFFFFF:08x}" == digest.get("crc")
+
+
+def stamp_crc(d: dict, default: Optional[Callable] = None) -> str:
+    """Serialize `d` as a JSON object with a trailing `"crc"` field
+    covering every other field's serialized bytes.
+
+    The crc is spliced into the dumped text, so
+    `verify_crc(json.loads(stamp_crc(d)))` holds by construction: the
+    reader pops `"crc"` and re-dumps the remaining (order-preserved)
+    dict — json round-trips are byte-stable for the types the stream
+    and manifest carry (shortest-repr floats, ints, strings, lists,
+    dicts). Document CRCs are pinned to stdlib crc32 (module docstring).
+    """
+    body = json.dumps(d, default=default)
+    crc = crc_hex(body.encode())
+    if body == "{}":
+        return f'{{"crc": "{crc}"}}'
+    return f'{body[:-1]}, "crc": "{crc}"}}'
+
+
+def verify_crc(d: dict) -> bool:
+    """True when a parsed `stamp_crc` document's `"crc"` matches the
+    other fields. A document WITHOUT a crc field fails: the caller
+    checks format version first and only verifies stamped documents."""
+    crc = d.get("crc")
+    if not isinstance(crc, str):
+        return False
+    body = json.dumps({k: v for k, v in d.items() if k != "crc"})
+    return crc == crc_hex(body.encode())
+
+
+# -------------------------------------------------------------------- retry
+
+
+def retry_io(
+    fn: Callable,
+    *,
+    what: str,
+    attempts: int = 3,
+    backoff_s: float = 0.05,
+    retry_on: Tuple[type, ...] = (OSError,),
+):
+    """Run `fn()` with bounded retry + exponential backoff (the PR-1
+    multihost retry shape): `attempts` tries, `backoff_s * 2**attempt`
+    seconds between them (capped at 30 s), a warning per failed attempt,
+    and the LAST error re-raised when every attempt fails — transient
+    injected `ioerror`/`enospc` (and real flaky disks) are absorbed with
+    zero trajectory change, persistent failures stay loud."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if attempt + 1 < attempts:
+                delay = min(backoff_s * (2.0**attempt), 30.0)
+                warnings.warn(
+                    f"{what} failed (attempt {attempt + 1}/{attempts}): "
+                    f"{e}; retrying in {delay:.2f}s"
+                )
+                time.sleep(delay)
+    assert last is not None
+    raise last
+
+
+# --------------------------------------------------------------- fault shim
+
+
+class StorageFaultShim:
+    """Chaos injection for the byte paths (module docstring).
+
+    Thread-safe: the op counters sit behind a lock because the cohort
+    prefetcher reads chunks on a background thread while the main
+    thread writes the stream. The DRAW for op k is pure in
+    (plan seed, direction, k); only the op ordering itself is
+    execution-dependent.
+    """
+
+    READ, WRITE = 0, 1
+
+    def __init__(self, plan: FaultPlan):
+        if plan.storage_p <= 0.0:
+            raise ValueError(
+                "StorageFaultShim needs a plan with storage_p > 0 "
+                "(build one only when the storage axis is scheduled)"
+            )
+        self.plan = plan
+        self._seed = fold_seed(plan.seed, "storage")
+        self._ops = [0, 0]  # read / write ordinals
+        self.injected = 0  # scoreboard: faults actually fired
+        self._lock = threading.Lock()
+
+    def _draw(self, direction: int) -> Optional[np.random.Generator]:
+        """The per-op rng when this op is scheduled to fault, else None."""
+        with self._lock:
+            op = self._ops[direction]
+            self._ops[direction] += 1
+        rng = np.random.default_rng([self._seed, direction, op])
+        if rng.random() >= self.plan.storage_p:
+            return None
+        with self._lock:
+            self.injected += 1
+        return rng
+
+    def read_bytes(self, path: str) -> bytes:
+        """The file's bytes, possibly corrupted (bitrot/torn) or refused
+        (ioerror) by the schedule. The file on disk is never touched —
+        a clean re-read is always possible, which is exactly what the
+        caller's verified retry exploits."""
+        mode = self.plan.storage_mode
+        rng = self._draw(self.READ)
+        if rng is not None and mode == "ioerror":
+            raise OSError(
+                errno.EIO, f"injected storage I/O error reading {path}"
+            )
+        with open(path, "rb") as f:
+            data = bytearray(f.read())
+        if rng is None or not data:
+            return bytes(data)
+        if mode == "bitrot":
+            for _ in range(max(1, int(self.plan.storage_strength))):
+                pos = int(rng.integers(len(data)))
+                data[pos] ^= 1 << int(rng.integers(8))
+        elif mode == "torn":
+            del data[int(rng.integers(len(data))):]
+        return bytes(data)
+
+    def before_write(self, what: str) -> None:
+        """Raise the scheduled transient write fault, BEFORE any bytes
+        move (so a refused write never half-lands; the caller retries
+        and the eventual write is whole). Only the error modes fire on
+        writes — bitrot/torn are read-side (module docstring)."""
+        if self.plan.storage_mode not in ("ioerror", "enospc"):
+            return
+        if self._draw(self.WRITE) is None:
+            return
+        if self.plan.storage_mode == "enospc":
+            raise OSError(
+                errno.ENOSPC, f"injected ENOSPC writing {what}"
+            )
+        raise OSError(errno.EIO, f"injected I/O error writing {what}")
+
+
+def storage_shim_for(plan: Optional[FaultPlan]) -> Optional[StorageFaultShim]:
+    """The shim for a plan's storage axis, or None when none is
+    scheduled (the no-shim fast path: mmap reads, un-intercepted
+    writes)."""
+    if plan is None or not plan.has_storage:
+        return None
+    return StorageFaultShim(plan)

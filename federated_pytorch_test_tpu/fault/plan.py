@@ -68,6 +68,19 @@ And one CHURN axis (fleet availability, virtual-client populations):
   crashed+resumed runs see the identical pool. The axis only exists
   over a virtual population (the engine rejects churn plans without
   `--virtual-clients`: a fixed cross-silo cohort has no pool to leave).
+
+And one STORAGE axis (the disk, not the wire):
+
+* **storage** — each chunk/stream I/O op faults independently with
+  probability `storage_p` (`storage=<p>:<mode>[:strength]`), through
+  the fault-pluggable shim in fault/io.py: `bitrot` flips bits in the
+  bytes a read returns, `torn` truncates them (both read-side — disk
+  intact, so the checksum layer in clients/store.py detects and a
+  re-read heals), `ioerror`/`enospc` raise transient OSErrors absorbed
+  by bounded retry. Draws are pure in (seed fold, direction, op
+  ordinal) rather than the round cursor: which I/O ops exist depends
+  on cache and residency state, so the axis is deterministic per
+  execution, not replay-pure like the wire axes (docs/FAULT.md).
 """
 
 from __future__ import annotations
@@ -89,6 +102,12 @@ class InjectedCrash(RuntimeError):
 # 0 is reserved for "no corruption this round".
 CORRUPT_MODES = {"scale": 1, "signflip": 2, "nan_burst": 3, "gauss": 4}
 
+# Storage fault modes (fault/io.py StorageFaultShim). bitrot/torn corrupt
+# the bytes a READ returns (the file on disk stays intact, so a verified
+# re-read heals); ioerror/enospc raise transient OSErrors on reads and
+# writes, absorbed by the bounded retry in the disk-facing callers.
+STORAGE_MODES = ("bitrot", "torn", "ioerror", "enospc")
+
 # THE seed-fold registry: every independently-seeded schedule axis folds
 # `base_seed + SEED_FOLDS[axis]` into its SeedSequence, so adding one
 # axis to a plan perturbs none of the others' draws. These offsets used
@@ -107,6 +126,7 @@ SEED_FOLDS = {
     "speed": 3,
     "cohort": 4,
     "churn": 5,
+    "storage": 6,
 }
 
 
@@ -168,6 +188,15 @@ class FaultPlan:
     # would be invisible to a per-loop pool).
     churn_p: float = 0.0
     churn_mean_absence: float = 2.0
+    # storage faults (module docstring; fault/io.py): each chunk/stream
+    # I/O op faults independently with `storage_p`. `storage_strength`
+    # is the bit-flip count for `bitrot` (ignored by the other modes).
+    # Unlike the round-cursor axes the draw is per-I/O-OP — pure in
+    # (seed fold, direction, op ordinal), not in the round cursor,
+    # because which ops exist depends on cache/residency state.
+    storage_p: float = 0.0
+    storage_mode: str = "bitrot"
+    storage_strength: float = 1.0
 
     def __post_init__(self):
         # types FIRST, so a wrong-typed field (a JSON plan with
@@ -182,12 +211,13 @@ class FaultPlan:
             "corrupt_p", "corrupt_strength",
             "slow_p", "slow_factor", "step_time_s",
             "churn_p", "churn_mean_absence",
+            "storage_p", "storage_strength",
         ):
             v = getattr(self, name)
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise ValueError(f"{name} must be a number, got {v!r}")
         for name in ("dropout_p", "straggler_p", "corrupt_p", "slow_p",
-                     "churn_p"):
+                     "churn_p", "storage_p"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
@@ -233,6 +263,18 @@ class FaultPlan:
                 f"churn_mean_absence must be finite and >= 1, "
                 f"got {self.churn_mean_absence}"
             )
+        if self.storage_mode not in STORAGE_MODES:
+            raise ValueError(
+                f"storage_mode must be one of {sorted(STORAGE_MODES)}, "
+                f"got {self.storage_mode!r}"
+            )
+        if not (
+            np.isfinite(self.storage_strength) and self.storage_strength > 0
+        ):
+            raise ValueError(
+                f"storage_strength must be finite and > 0, "
+                f"got {self.storage_strength}"
+            )
 
     @property
     def has_corruption(self) -> bool:
@@ -249,6 +291,11 @@ class FaultPlan:
         """Whether any loop of this plan can remove a client from the
         available pool."""
         return self.churn_p > 0.0
+
+    @property
+    def has_storage(self) -> bool:
+        """Whether any I/O op of this plan can fault (fault/io.py)."""
+        return self.storage_p > 0.0
 
     # ------------------------------------------------------------- schedule
 
@@ -474,6 +521,9 @@ class FaultPlan:
         `churn=<p>[:mean_absence]` schedules per-outer-loop availability
         churn over a virtual population (p = per-loop departure
         probability, mean_absence = mean absence length in loops).
+        `storage=<p>:<bitrot|torn|ioerror|enospc>[:strength]` schedules
+        per-I/O-op storage faults (fault/io.py; strength = bit-flip
+        count for bitrot).
         """
         if os.path.exists(spec):
             with open(spec) as f:
@@ -545,10 +595,21 @@ class FaultPlan:
                 kw["churn_p"] = float(parts[0])
                 if len(parts) == 2:
                     kw["churn_mean_absence"] = float(parts[1])
+            elif key == "storage":
+                parts = val.split(":")
+                if not 2 <= len(parts) <= 3:
+                    raise ValueError(
+                        f"storage spec {val!r} must be "
+                        "<p>:<mode>[:strength]"
+                    )
+                kw["storage_p"] = float(parts[0])
+                kw["storage_mode"] = parts[1]
+                if len(parts) == 3:
+                    kw["storage_strength"] = float(parts[2])
             else:
                 raise ValueError(
                     f"unknown fault-plan key {key!r} "
                     "(have seed, dropout, straggler, crash, corrupt, "
-                    "slow, step_time, churn)"
+                    "slow, step_time, churn, storage)"
                 )
         return cls(crashes=tuple(crashes), **kw)

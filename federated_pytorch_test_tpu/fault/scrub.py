@@ -1,0 +1,290 @@
+"""`scrub` CLI verb: offline storage-integrity walk + repair.
+
+    python -m federated_pytorch_test_tpu scrub <dir> [--repair]
+
+Walks a client-store / checkpoint directory, verifies every
+manifest-referenced chunk file against the checksum its manifest
+recorded (clients/store.py v2 manifests, fault/io.py digests), and
+either REPORTS — exit 1, naming every bad file — or REPAIRS
+(`--repair`), mirroring the store's runtime ladder offline:
+
+1. an older on-disk version of the same chunk that still verifies (or,
+   for legacy digest-less files, still parses) is adopted: every
+   manifest referencing the corrupt file is rewritten to the prior
+   version, its digest recomputed, the manifest self-CRC re-stamped;
+2. otherwise the chunk id is DROPPED from the manifests — the store
+   re-initializes those rows pristine by construction at next load
+   (`_materialize`), which is the same rows a never-spilled run holds;
+3. the corrupt file itself is renamed `<name>.corrupt` so nothing can
+   ever re-adopt it.
+
+A corrupt MANIFEST (unparsable, or a parsable v2 document failing its
+self-CRC) is reported; with `--repair` it is quarantined the same way,
+so the trainer's restore loop falls back to the previous intact step.
+Legacy v1 manifests and digest-less chunk files are accepted read-only
+(the format contract) — scrub still parse-checks the files and counts
+them separately, but absence of a digest is not a problem.
+
+Engine-import-free by the report/watch rule (__main__.py): only stdlib,
+numpy, fault/io.py and clients/store.py helpers — no accelerator
+backend is ever initialized, so scrubbing a dead host's store works.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from federated_pytorch_test_tpu.fault.io import (
+    IntegrityError,
+    checksum,
+    stamp_crc,
+    verify_crc,
+    verify_digest,
+)
+
+_MANIFEST_RE = re.compile(r"^manifest_step_(\d+)\.json$")
+_CHUNK_RE = re.compile(r"^chunk_(\d{6})_v(\d{8})\.npz$")
+
+
+def _parse_manifest(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """`(manifest, None)` or `(None, reason)` — a v2 manifest must pass
+    its self-CRC (clients/store.py `load` applies the same gate)."""
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable manifest: {e}"
+    if not isinstance(manifest, dict):
+        return None, "manifest is not a JSON object"
+    version = manifest.get("version")
+    if int(version or 0) >= 2 and not verify_crc(manifest):
+        return None, "manifest failed its self-checksum (bit rot)"
+    return manifest, None
+
+
+def _chunk_ok(path: str, digest: Optional[dict]) -> Optional[str]:
+    """None if the chunk file is intact, else the failure reason.
+
+    With a digest the bytes are authoritative; without one (legacy) the
+    file must at least parse as the npz the store would read."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        return f"unreadable: {e}"
+    if digest is not None:
+        if not verify_digest(data, digest):
+            return "failed checksum verification"
+        return None
+    from federated_pytorch_test_tpu.clients.store import _npz_from_bytes
+
+    try:
+        _npz_from_bytes(data, path)
+    except IntegrityError as e:
+        return f"legacy (digest-less) chunk does not parse: {e}"
+    return None
+
+
+def _quarantine(path: str) -> None:
+    os.replace(path, path + ".corrupt")
+
+
+def _rewrite_manifest(path: str, manifest: dict) -> None:
+    """Atomic manifest rewrite; v2+ documents get a fresh self-CRC."""
+    manifest.pop("crc", None)
+    if int(manifest.get("version") or 0) >= 2:
+        text = stamp_crc(manifest)
+    else:
+        text = json.dumps(manifest)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _find_prior(root: str, fname: str) -> Optional[str]:
+    """The newest OLDER on-disk version of `fname`'s chunk id, or None."""
+    m = _CHUNK_RE.match(fname)
+    if m is None:
+        return None
+    cid, seq = int(m.group(1)), int(m.group(2))
+    priors: List[Tuple[int, str]] = []
+    for entry in os.listdir(root):
+        pm = _CHUNK_RE.match(entry)
+        if pm and int(pm.group(1)) == cid and int(pm.group(2)) != seq:
+            priors.append((int(pm.group(2)), entry))
+    for _, prior in sorted(priors, reverse=True):
+        if _chunk_ok(os.path.join(root, prior), None) is None:
+            return prior
+    return None
+
+
+def scrub_dir(root: str, repair: bool = False) -> dict:
+    """Scrub one store/checkpoint directory; returns the report dict
+    (`problems` lists what is still wrong AFTER any repairs)."""
+    entries = sorted(os.listdir(root))
+    manifest_names = [e for e in entries if _MANIFEST_RE.match(e)]
+    manifests: Dict[str, dict] = {}
+    problems: List[str] = []
+    repaired: List[str] = []
+
+    for name in manifest_names:
+        path = os.path.join(root, name)
+        manifest, reason = _parse_manifest(path)
+        if manifest is None:
+            if repair:
+                _quarantine(path)
+                repaired.append(f"{name}: {reason} -> quarantined .corrupt")
+            else:
+                problems.append(f"{name}: {reason}")
+            continue
+        manifests[name] = manifest
+
+    # per chunk file: the referencing manifests and the digest the
+    # NEWEST manifest recorded for it (newer saves re-stamp digests)
+    refs: Dict[str, List[str]] = {}
+    digests: Dict[str, dict] = {}
+    for name in sorted(manifests, key=lambda n: int(_MANIFEST_RE.match(n).group(1))):
+        manifest = manifests[name]
+        for _, fname in manifest.get("chunks", {}).items():
+            refs.setdefault(fname, []).append(name)
+        for fname, digest in (manifest.get("digests") or {}).items():
+            digests[fname] = digest
+
+    verified = 0
+    legacy = 0
+    for fname in sorted(refs):
+        path = os.path.join(root, fname)
+        digest = digests.get(fname)
+        if not os.path.exists(path):
+            reason = "missing from disk"
+        else:
+            reason = _chunk_ok(path, digest)
+        if reason is None:
+            verified += 1
+            if digest is None:
+                legacy += 1
+            continue
+        if not repair:
+            problems.append(f"{fname}: {reason}")
+            continue
+        # the offline repair ladder (module docstring): prior version,
+        # else drop the chunk id so rows re-init pristine at next load
+        prior = _find_prior(root, fname)
+        m = _CHUNK_RE.match(fname)
+        cid = int(m.group(1)) if m else None
+        for mname in refs[fname]:
+            manifest = manifests[mname]
+            chunks = manifest.get("chunks", {})
+            hit = [c for c, f in chunks.items() if f == fname]
+            for c in hit:
+                if prior is not None:
+                    chunks[c] = prior
+                else:
+                    del chunks[c]
+            dig = manifest.get("digests")
+            if isinstance(dig, dict):
+                dig.pop(fname, None)
+                if prior is not None:
+                    with open(os.path.join(root, prior), "rb") as f:
+                        dig[prior] = checksum(f.read())
+            _rewrite_manifest(os.path.join(root, mname), manifest)
+        if os.path.exists(path):
+            _quarantine(path)
+        if prior is not None:
+            repaired.append(
+                f"{fname}: {reason} -> adopted prior version {prior} "
+                f"in {len(refs[fname])} manifest(s)"
+            )
+        else:
+            repaired.append(
+                f"{fname}: {reason} -> no intact prior version; chunk "
+                f"{cid} dropped ({len(refs[fname])} manifest(s)) — rows "
+                "re-initialize pristine at next load"
+            )
+
+    return {
+        "root": root,
+        "manifests": len(manifest_names),
+        "chunks": len(refs),
+        "verified": verified,
+        "legacy_no_digest": legacy,
+        "problems": problems,
+        "repaired": repaired,
+    }
+
+
+def scrub_main(argv=None) -> int:
+    """`python -m federated_pytorch_test_tpu scrub <dir>` — exit 0 when
+    every checksum verifies (or every problem was repaired), 1
+    otherwise, naming each offending file on stdout."""
+    ap = argparse.ArgumentParser(
+        prog="federated_pytorch_test_tpu scrub",
+        description=(
+            "Walk a client-store / checkpoint directory, verify every "
+            "manifest-referenced chunk file's checksum, and report or "
+            "(--repair) repair (docs/FAULT.md §Storage-integrity axis)."
+        ),
+    )
+    ap.add_argument("dir", help="store / checkpoint directory to scrub")
+    ap.add_argument(
+        "--repair",
+        action="store_true",
+        help="repair instead of report: adopt an intact prior chunk "
+        "version where one exists, drop the chunk (rows re-init "
+        "pristine) where none does, quarantine corrupt files as "
+        "<name>.corrupt",
+    )
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        print(f"scrub: {args.dir!r} is not a directory")
+        return 1
+
+    # walk: a checkpoint dir keeps its store under `client_store/`
+    # (clients/store.py `save`), so scrub every nested dir that holds
+    # manifests rather than requiring the exact store root
+    roots = [
+        dirpath
+        for dirpath, _, filenames in sorted(os.walk(args.dir))
+        if any(_MANIFEST_RE.match(f) for f in filenames)
+    ]
+    if not roots:
+        print(f"# scrub: no store manifests under {args.dir!r}; nothing to do")
+        return 0
+
+    totals = {"manifests": 0, "chunks": 0, "verified": 0,
+              "legacy_no_digest": 0, "problems": 0, "repaired": 0}
+    for root in roots:
+        report = scrub_dir(root, repair=args.repair)
+        rel = os.path.relpath(root, args.dir)
+        for line in report["repaired"]:
+            print(f"scrub: {rel}: repaired {line}")
+        for line in report["problems"]:
+            print(f"scrub: {rel}: CORRUPT {line}")
+        totals["manifests"] += report["manifests"]
+        totals["chunks"] += report["chunks"]
+        totals["verified"] += report["verified"]
+        totals["legacy_no_digest"] += report["legacy_no_digest"]
+        totals["problems"] += len(report["problems"])
+        totals["repaired"] += len(report["repaired"])
+    print(
+        f"# scrub: {len(roots)} store root(s), "
+        f"{totals['manifests']} manifest(s), "
+        f"{totals['chunks']} chunk file(s), {totals['verified']} "
+        f"verified ({totals['legacy_no_digest']} legacy without digest), "
+        f"{totals['problems']} problem(s), "
+        f"{totals['repaired']} repaired"
+    )
+    return 1 if totals["problems"] else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(scrub_main())

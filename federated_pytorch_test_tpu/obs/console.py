@@ -236,6 +236,29 @@ def _render_run(name: str, run: RunStream) -> List[str]:
             if store.get("spill_reads"):
                 parts.append(f"spill reads {store['spill_reads']}")
             lines.append("   store  " + " | ".join(parts))
+        intg = status.get("integrity")
+        if isinstance(intg, dict):
+            # storage-integrity digest (clients/store.py, docs/FAULT.md
+            # §Storage-integrity axis): verified spill reads vs detected
+            # corruption and how the repair ladder resolved it
+            parts = [
+                f"checksums {'on' if intg.get('checksums') else 'off'}",
+                f"verified reads {intg.get('verified_reads', 0)}",
+            ]
+            if intg.get("failures"):
+                parts.append(f"failures {intg['failures']}")
+            if intg.get("retry_heals"):
+                parts.append(f"retry heals {intg['retry_heals']}")
+            if intg.get("repairs_prior") or intg.get("repairs_reinit"):
+                parts.append(
+                    f"repairs {intg.get('repairs_prior', 0)} prior / "
+                    f"{intg.get('repairs_reinit', 0)} reinit"
+                )
+            if status.get("storage_faults"):
+                parts.append(
+                    f"injected faults {status['storage_faults']}"
+                )
+            lines.append("   integrity " + " | ".join(parts))
     bundles = list_incidents(run.path)
     if bundles:
         names = []

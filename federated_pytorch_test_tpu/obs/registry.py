@@ -16,7 +16,12 @@ whose header version is unsupported, is REFUSED (skipped with a warning
 in directory mode) rather than half-parsed — splicing a foreign file
 into a comparison would be worse than dropping it. Within an accepted
 stream the same tolerance applies: a torn final line (crash mid-write)
-is dropped, and nothing past the first unparsable line is trusted.
+is dropped, and nothing past the first unparsable line is trusted. For
+version-2 streams every line carries a CRC (fault/io.py): a bit-rotted
+but still-parsable line is dropped — with everything after it — exactly
+like a torn tail, instead of being spliced into the report as truth.
+Version-1 streams (pre-integrity archives) are still accepted, without
+the per-line check.
 `--match SUBSTR` additionally refuses streams whose header tag does not
 contain the substring (the registry-side analogue of the resume tag
 check, for directories that mix experiments).
@@ -39,9 +44,14 @@ import os
 import warnings
 from typing import Dict, List, Optional, Tuple
 
+from federated_pytorch_test_tpu.fault.io import verify_crc
 from federated_pytorch_test_tpu.obs.sinks import STREAM_VERSION
 
 REPORT_VERSION = 1
+
+# stream format versions this reader accepts: v1 (no per-line CRC —
+# archived pre-integrity runs) and the current checksummed v2
+_READ_VERSIONS = (1, STREAM_VERSION)
 
 
 class StreamRefused(ValueError):
@@ -70,6 +80,7 @@ def read_stream(path: str, name: Optional[str] = None) -> RunStream:
     with open(path, "rb") as f:
         data = f.read()
     run = None
+    checked = False
     for raw in data.splitlines(keepends=True):
         if not raw.endswith(b"\n"):
             break  # torn tail from a crash mid-write
@@ -83,11 +94,16 @@ def read_stream(path: str, name: Optional[str] = None) -> RunStream:
                     f"{path}: first line is not a stream_header — not a "
                     "metric stream"
                 )
-            if d.get("version") != STREAM_VERSION:
+            if d.get("version") not in _READ_VERSIONS:
                 raise StreamRefused(
-                    f"{path}: stream version {d.get('version')!r} != "
-                    f"{STREAM_VERSION} — refusing to misread a foreign "
+                    f"{path}: stream version {d.get('version')!r} not in "
+                    f"{_READ_VERSIONS} — refusing to misread a foreign "
                     "format"
+                )
+            checked = d.get("version") >= 2  # v2+: per-line CRC stamped
+            if checked and not verify_crc(d):
+                raise StreamRefused(
+                    f"{path}: stream_header failed its line checksum"
                 )
             run = RunStream(
                 name or os.path.splitext(os.path.basename(path))[0],
@@ -95,6 +111,10 @@ def read_stream(path: str, name: Optional[str] = None) -> RunStream:
                 path,
             )
             continue
+        if checked:
+            if not verify_crc(d):
+                break  # bit-rotted line: dropped like a torn tail
+            d.pop("crc", None)
         if d.get("event") == "nloop_complete":
             run.markers.append(int(d.get("nloop", -1)))
         elif "series" in d:
@@ -373,6 +393,41 @@ class RunRegistry:
                 )
         return {"count": len(rows), "bundles": rows}
 
+    def integrity(self) -> dict:
+        """The cross-run storage-integrity table (`report --integrity`):
+        each ingested stream's `<stream>.status.json` sidecar carries the
+        store's integrity digest (verified reads, checksum failures,
+        retry heals, repairs — clients/store.py `integrity_digest`).
+        These are PROCESS facts — a crashed+resumed twin legitimately
+        differs from its uninterrupted twin in every one of them — so
+        they live behind this explicit flag, never in the default
+        report document (the determinism contract, module docstring)."""
+        rows = []
+        for name, run in sorted(self.runs.items()):
+            path = run.path + ".status.json"
+            try:
+                with open(path) as f:
+                    status = json.load(f)
+            except (OSError, ValueError):
+                continue
+            dig = status.get("integrity")
+            if not isinstance(dig, dict):
+                continue
+            rows.append(
+                {
+                    "run": name,
+                    "checksums": dig.get("checksums"),
+                    "alg": dig.get("alg"),
+                    "verified_reads": dig.get("verified_reads"),
+                    "failures": dig.get("failures"),
+                    "retry_heals": dig.get("retry_heals"),
+                    "repairs_prior": dig.get("repairs_prior"),
+                    "repairs_reinit": dig.get("repairs_reinit"),
+                    "storage_faults": status.get("storage_faults"),
+                }
+            )
+        return {"count": len(rows), "runs": rows}
+
     def report(self) -> dict:
         """The full cross-run document: per-run summaries + curves,
         round-aligned comparison series, the convergence-vs-bytes
@@ -539,6 +594,37 @@ def render_markdown(doc: dict) -> str:
             "`*` = on the frontier: no other run reached at least this "
             "accuracy in at most this simulated round wall."
         )
+    if doc.get("integrity") is not None:
+        intg = doc["integrity"]
+        lines += ["", "## Storage integrity", ""]
+        if not intg["runs"]:
+            lines.append(
+                "No status sidecars with integrity digests next to the "
+                "ingested streams."
+            )
+        else:
+            lines.append(
+                "| run | checksums | alg | verified reads | failures "
+                "| retry heals | repairs (prior) | repairs (reinit) "
+                "| injected storage faults |"
+            )
+            lines.append("|---|---|---|---|---|---|---|---|---|")
+            for r in intg["runs"]:
+                sf = r["storage_faults"]
+                lines.append(
+                    f"| {r['run']} | {'on' if r['checksums'] else 'off'} "
+                    f"| {r['alg'] or '-'} | {r['verified_reads']} "
+                    f"| {r['failures']} | {r['retry_heals']} "
+                    f"| {r['repairs_prior']} | {r['repairs_reinit']} "
+                    f"| {sf if sf is not None else '-'} |"
+                )
+            lines.append("")
+            lines.append(
+                "Integrity counters are process facts (a crashed+resumed "
+                "run legitimately differs from its uninterrupted twin) — "
+                "they appear only behind `--integrity`, never in the "
+                "default report."
+            )
     if doc.get("incidents") is not None:
         inc = doc["incidents"]
         lines += ["", "## Incidents", ""]
@@ -596,6 +682,13 @@ def report_main(argv=None) -> int:
         "bundles under each stream's .incidents/ dir, obs/flight.py)",
     )
     ap.add_argument(
+        "--integrity",
+        action="store_true",
+        help="add the per-run storage-integrity table (status-sidecar "
+        "digests: verified reads, checksum failures, repairs) — process "
+        "facts, so excluded from the default report",
+    )
+    ap.add_argument(
         "--quiet", action="store_true", help="suppress the stdout markdown"
     )
     args = ap.parse_args(argv)
@@ -611,6 +704,8 @@ def report_main(argv=None) -> int:
     doc = reg.report()
     if args.incidents:
         doc["incidents"] = reg.incidents()
+    if args.integrity:
+        doc["integrity"] = reg.integrity()
     md = render_markdown(doc)
     if args.json:
         with open(args.json, "w") as f:

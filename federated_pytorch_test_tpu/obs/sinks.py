@@ -7,13 +7,21 @@ it durable incrementally.
 
 The JSONL line protocol (one JSON object per line):
 
-    {"event": "stream_header", "version": 1, "tag": "<experiment tag>"}
-    {"series": "<name>", "t": ..., "value": ..., <context keys>}
-    {"event": "nloop_complete", "nloop": N}
+    {"event": "stream_header", "version": 2, "tag": "<tag>", "crc": "..."}
+    {"series": "<name>", "t": ..., "value": ..., <context>, "crc": "..."}
+    {"event": "nloop_complete", "nloop": N, "crc": "..."}
 
 * Every record is ONE line-buffered `write()` of a newline-terminated
   line, so a crash can tear at most the final line — never interleave or
   split earlier ones.
+* Version 2 stamps every line with a CRC over its other fields
+  (fault/io.py `stamp_crc`): the torn-tail tolerance used to trust any
+  JSON-PARSABLE line, so a bit-rotted-but-parsable line would have been
+  spliced into resume/report as truth — now it is dropped (with
+  everything after it) exactly like a torn tail. Version-1 streams are
+  still READ by the report tooling (obs/registry.py), but resume onto
+  one starts fresh: appending checksummed lines to an unchecksummed
+  stream would leave a file neither reader fully trusts.
 * `flush()` (called by the trainer once per partition round) pushes the
   buffer to the OS; `commit(nloop)` (called at each outer-loop checkpoint
   boundary) writes the marker line and fsyncs: everything before a marker
@@ -48,7 +56,9 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-STREAM_VERSION = 1
+from federated_pytorch_test_tpu.fault.io import retry_io, stamp_crc, verify_crc
+
+STREAM_VERSION = 2
 
 
 def jsonable(o: Any):
@@ -77,9 +87,14 @@ class JsonlSink:
 
     MARKER = "nloop_complete"
 
-    def __init__(self, path: str, tag: str = ""):
+    def __init__(self, path: str, tag: str = "", storage_io=None):
         self.path = os.path.abspath(path)
         self.tag = tag
+        # optional fault/io.py StorageFaultShim: the metrics stream is a
+        # disk-facing byte path too, so write-side chaos (ioerror/enospc
+        # plans) exercises it — reads go through obs/registry.py which
+        # verifies per-line CRCs instead
+        self._io = storage_io
         self._f = None
 
     # ------------------------------------------------------------ lifecycle
@@ -147,10 +162,32 @@ class JsonlSink:
                         f"{self.tag!r}); starting a fresh stream"
                     )
                     return [], None
+                if d.get("version") != STREAM_VERSION:
+                    # never append v2 checksummed lines to a v1 stream
+                    # (or vice versa): the mixed file would have no
+                    # version a reader could fully trust
+                    warnings.warn(
+                        f"metric stream {self.path} is format version "
+                        f"{d.get('version')!r} (writer is "
+                        f"{STREAM_VERSION}); starting a fresh stream"
+                    )
+                    return [], None
+                if not verify_crc(d):
+                    warnings.warn(
+                        f"metric stream {self.path} header failed its "
+                        "line checksum; starting a fresh stream"
+                    )
+                    return [], None
                 if resume_nloops == 0:
                     cut = end  # keep just the header; re-run records all
                 pos = end
                 continue
+            if not verify_crc(d):
+                # bit-rotted-but-parsable line: drop it AND everything
+                # after it, exactly like a torn tail — nothing past a
+                # corrupt line is trustworthy
+                break
+            d.pop("crc", None)  # replayed records match in-memory ones
             if d.get("event") == self.MARKER:
                 if int(d.get("nloop", -1)) == resume_nloops - 1:
                     # the restore point: records before it are final
@@ -179,8 +216,18 @@ class JsonlSink:
 
     def _write(self, d: dict) -> None:
         # one write per line; line buffering makes the newline the flush
-        # boundary, so a crash tears at most this line
-        self._f.write(json.dumps(d, default=jsonable) + "\n")
+        # boundary, so a crash tears at most this line. stamp_crc splices
+        # the line checksum in as the last key (fault/io.py).
+        line = stamp_crc(d, default=jsonable) + "\n"
+        if self._io is not None:
+            # chaos shim: transient write faults (ioerror/enospc plans)
+            # fire BEFORE the bytes move and get the shared bounded
+            # retry; the actual write below happens exactly once
+            retry_io(
+                lambda: self._io.before_write("metrics stream"),
+                what=f"metrics stream write ({os.path.basename(self.path)})",
+            )
+        self._f.write(line)
 
     def record(self, name: str, rec: dict) -> None:
         if self._f is not None:

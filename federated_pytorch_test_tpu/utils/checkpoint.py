@@ -36,6 +36,8 @@ from typing import Any
 import jax
 import numpy as np
 
+from federated_pytorch_test_tpu.fault.io import retry_io
+
 PyTree = Any
 
 
@@ -59,7 +61,9 @@ def _list_steps(root: str) -> list[int]:
     )
 
 
-def save_checkpoint(directory: str, state: PyTree, *, step: int) -> str:
+def save_checkpoint(
+    directory: str, state: PyTree, *, step: int, storage_io=None
+) -> str:
     """ATOMICALLY write `state` (a pytree of arrays) under `directory/step_N`.
 
     The tree is first materialized under the hidden staging path
@@ -72,6 +76,11 @@ def save_checkpoint(directory: str, state: PyTree, *, step: int) -> str:
     `./sK.model`); the brief gap while the stale tree is cleared is
     likewise covered by the loader's fall-back-to-next-newest.
 
+    `storage_io` is the optional fault/io.py StorageFaultShim: a plan's
+    write-side storage faults (ioerror/enospc) fire before the staging
+    write, survived by the shared bounded retry — the checkpoint writer
+    is a disk-facing byte path like the store and the metric stream.
+
     Returns the final checkpoint path.
     """
     root = os.path.abspath(directory)
@@ -79,9 +88,15 @@ def save_checkpoint(directory: str, state: PyTree, *, step: int) -> str:
     tmp = os.path.join(root, f".tmp_step_{step}")
     state = jax.tree.map(np.asarray, state)
     os.makedirs(root, exist_ok=True)
-    if os.path.exists(tmp):  # leftover staging dir from a crashed writer
-        shutil.rmtree(tmp)
-    _checkpointer().save(tmp, state, force=True)
+
+    def write():
+        if storage_io is not None:
+            storage_io.before_write(f"checkpoint step_{step}")
+        if os.path.exists(tmp):  # leftover staging from a crashed writer
+            shutil.rmtree(tmp)
+        _checkpointer().save(tmp, state, force=True)
+
+    retry_io(write, what=f"checkpoint write (step_{step})")
     if os.path.exists(path):
         shutil.rmtree(path)
     os.replace(tmp, path)

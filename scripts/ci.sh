@@ -92,7 +92,14 @@
 #                                    + in-bundle series == stream tail,
 #                                    real anomaly-armed jax.profiler
 #                                    capture, `report --incidents`
-#                                    table, `watch --once` renders)
+#                                    table, `watch --once` renders) and
+#                                    integrity_smoke (storage chaos at
+#                                    100k clients: bitrot plan + planned
+#                                    crash recovered via rerun with twin
+#                                    stream identity, transient-ioerror
+#                                    write plan survived via retry,
+#                                    scrub detect-then-repair, nonzero
+#                                    storage_faults= scoreboard)
 #
 # Every tier starts with a PREFLIGHT stray-process check (see
 # preflight() below): the tier-1 wall sits within ~10 s of the driver's
@@ -225,6 +232,7 @@ def norm(path):
     for line in open(path):
         d = json.loads(line)
         d.pop("t", None)
+        d.pop("crc", None)
         if d.get("event") == "stream_header":
             d.pop("tag", None)
         if d.get("series") == "step_time":
@@ -911,6 +919,123 @@ PY
   rm -rf "$d"
 }
 
+integrity_smoke() {
+  # Storage-integrity axis through the REAL CLI (fault/io.py,
+  # docs/FAULT.md §Storage-integrity axis): a 100k-client spilled run
+  # (telemetry weighting, so every loop re-reads the spilled chunks
+  # through the verify-on-read path) under an injected bitrot plan
+  # with a planned crash at (nloop=1, gid=2, nadmm=0), recovered by
+  # rerunning the IDENTICAL command — resume-time verify_all and the
+  # bounded retry heal every hit (the disk is intact; only read
+  # buffers are corrupted), so the crashed+resumed stream is
+  # byte-identical to an uninterrupted twin's. A second leg survives a
+  # transient-ioerror plan on the write paths (spills, stream lines,
+  # checkpoint staging). Then the offline ladder: bit-flip a chunk
+  # file in the twin's store, `scrub` exits nonzero NAMING it,
+  # `scrub --repair` resolves it, and a re-scrub is clean. Both run
+  # logs must show a nonzero `storage_faults=` scoreboard entry.
+  # --no-prefetch pins the shim's per-op draw schedule: background
+  # gathers would interleave read ordinals nondeterministically.
+  local d; d="$(mktemp -d)"
+  local base=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 320 --synthetic-n-test 60 --batch 20
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --virtual-clients 100000 --cohort 16 --data-shards 8 --cohort-seed 11
+    --cohort-weighting telemetry --no-prefetch
+    --store-chunk-clients 8 --store-resident-chunks 2
+    --save-model --resume auto)
+  local cmd=("${base[@]}" --fault-plan "seed=7,storage=0.1:bitrot,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${base[@]}" --fault-plan "seed=7,storage=0.1:bitrot"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  echo "integrity smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "integrity smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "integrity smoke: resuming through the verify gate..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "integrity smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "integrity smoke FAILED: the twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  for log in run2 twin; do
+    grep -Eq 'storage_faults=[1-9]' "$d/$log.log" || {
+      echo "integrity smoke FAILED: $log scoreboard shows no storage faults" >&2
+      grep '# faults injected' "$d/$log.log" >&2; rm -rf "$d"; return 1
+    }
+  done
+  grep -q 'checksum verification' "$d/run2.log" || {
+    echo "integrity smoke FAILED: no bitrot hit was ever detected" >&2
+    rm -rf "$d"; return 1
+  }
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+assert not any(d.get("series") == "incident" for d in recs)
+' || {
+    echo "integrity smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  if ! python - "$d/run.jsonl.status.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc.get("completed"), "sidecar not stamped completed"
+dig = doc.get("integrity") or {}
+assert dig.get("checksums") and dig.get("verified_reads", 0) > 0, dig
+assert dig.get("failures", 0) > 0, dig          # rot was DETECTED...
+assert dig.get("retry_heals", 0) > 0, dig       # ...and healed
+assert not dig.get("repairs_prior") and not dig.get("repairs_reinit"), dig
+assert doc.get("storage_faults", 0) > 0, doc.get("storage_faults")
+print(
+    f"integrity smoke: verified_reads={dig['verified_reads']} "
+    f"failures={dig['failures']} retry_heals={dig['retry_heals']}"
+)
+PY
+  then
+    echo "integrity smoke FAILED: integrity sidecar gate" >&2
+    rm -rf "$d"; return 1
+  fi
+  echo "integrity smoke: surviving a transient-ioerror write plan..."
+  "${base[@]}" --fault-plan "seed=3,storage=0.05:ioerror" \
+    --checkpoint-dir "$d/ckpt_io" --metrics-stream "$d/io.jsonl" \
+    > "$d/io.log" 2>&1 || {
+    echo "integrity smoke FAILED: ioerror plan run did not finish" >&2
+    tail -20 "$d/io.log" >&2; rm -rf "$d"; return 1
+  }
+  grep -Eq 'storage_faults=[1-9]' "$d/io.log" && grep -q 'retrying' "$d/io.log" || {
+    echo "integrity smoke FAILED: ioerror plan injected/retried nothing" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "integrity smoke: scrub detect-then-repair..."
+  local chunk
+  chunk="$(ls "$d/ckpt_twin/client_store/" | grep '^chunk_' | head -1)"
+  python -c "
+p = '$d/ckpt_twin/client_store/$chunk'
+b = bytearray(open(p, 'rb').read()); b[120] ^= 0xFF
+open(p, 'wb').write(bytes(b))"
+  if python -m federated_pytorch_test_tpu scrub "$d/ckpt_twin" > "$d/scrub1.out" 2>&1; then
+    echo "integrity smoke FAILED: scrub missed the corrupt chunk" >&2
+    cat "$d/scrub1.out" >&2; rm -rf "$d"; return 1
+  fi
+  grep -q "CORRUPT $chunk" "$d/scrub1.out" || {
+    echo "integrity smoke FAILED: scrub did not name the chunk" >&2
+    cat "$d/scrub1.out" >&2; rm -rf "$d"; return 1
+  }
+  python -m federated_pytorch_test_tpu scrub "$d/ckpt_twin" --repair \
+    > "$d/scrub2.out" 2>&1 || {
+    echo "integrity smoke FAILED: scrub --repair left problems" >&2
+    cat "$d/scrub2.out" >&2; rm -rf "$d"; return 1
+  }
+  python -m federated_pytorch_test_tpu scrub "$d/ckpt_twin" > "$d/scrub3.out" 2>&1 || {
+    echo "integrity smoke FAILED: store still dirty after repair" >&2
+    cat "$d/scrub3.out" >&2; rm -rf "$d"; return 1
+  }
+  echo "integrity smoke OK"
+  rm -rf "$d"
+}
+
 tier="${CI_TIER:-all}"
 preflight
 case "$tier" in
@@ -927,6 +1052,7 @@ case "$tier" in
     fleet_smoke
     report_smoke
     incident_smoke
+    integrity_smoke
     ;;
   all)
     run_tier tier1 tests/ -m 'not slow' -q "$@"
@@ -940,6 +1066,7 @@ case "$tier" in
     fleet_smoke
     report_smoke
     incident_smoke
+    integrity_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;
 esac

@@ -62,6 +62,7 @@ def norm_stream():
         for line in open(path):
             d = json.loads(line)
             d.pop("t", None)
+            d.pop("crc", None)  # per-line checksums differ with content
             if d.get("event") == "stream_header":
                 d.pop("tag", None)
             if d.get("series") == "step_time":

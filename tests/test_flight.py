@@ -303,6 +303,9 @@ def _stream_rounds(path):
         rec = json.loads(line)
         if "series" not in rec:
             continue
+        # the line-format CRC is stamped at serialization (v2 stream,
+        # fault/io.py) — not a record field the ring ever saw
+        rec.pop("crc", None)
         cur.append(rec)
         if rec["series"] == "dispatch_count":
             rounds.append(cur)

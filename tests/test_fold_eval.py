@@ -178,6 +178,7 @@ def _normalize_stream(path):
     for line in open(path):
         d = json.loads(line)
         d.pop("t", None)  # wall-clock
+        d.pop("crc", None)  # per-line checksums differ with content
         if d.get("series") == "step_time":
             d["value"] = {k: v for k, v in d["value"].items() if k != "seconds"}
         out.append(d)

@@ -102,6 +102,56 @@ def test_prefetcher_match_discard_and_error_fallback():
     assert p.take(4, np.array([6])) is None
 
 
+def test_prefetcher_transient_io_retries_before_degrading():
+    # the Failure rule's first half: a worker tripping over transient
+    # I/O (flaky disk, chaos-injected ioerror) gets the shared bounded
+    # retry and the payload is ADOPTED — no synchronous degrade
+    calls = [0]
+
+    def flaky(nloop, ids, dirty):
+        calls[0] += 1
+        if calls[0] < 3:
+            raise OSError("injected storage I/O error")
+        return {"ok": calls[0]}
+
+    p = CohortPrefetcher(flaky, io_retries=3)
+    p.launch(0, np.array([1]), np.array([], np.int64))
+    with pytest.warns(UserWarning, match="retrying"):
+        assert p.take(0, np.array([1])) == {"ok": 3}
+    assert calls[0] == 3
+
+    # exhausted retries degrade to the synchronous gather (None),
+    # naming the chunk file when the error carries one
+    from federated_pytorch_test_tpu.fault import IntegrityError
+
+    def rotted(nloop, ids, dirty):
+        raise IntegrityError(
+            "chunk failed checksum verification",
+            path="/store/chunk_000007_v00000042.npz",
+        )
+
+    p = CohortPrefetcher(rotted, io_retries=2)
+    p.launch(1, np.array([2]), np.array([], np.int64))
+    with pytest.warns(UserWarning) as rec:
+        assert p.take(1, np.array([2])) is None
+    text = "\n".join(str(w.message) for w in rec)
+    assert "chunk file: /store/chunk_000007_v00000042.npz" in text
+    assert "gathering synchronously" in text
+
+    # deterministic (non-I/O) worker bugs fail FAST: one attempt only
+    calls[0] = 0
+
+    def buggy(nloop, ids, dirty):
+        calls[0] += 1
+        raise TypeError("bug")
+
+    p = CohortPrefetcher(buggy, io_retries=3)
+    p.launch(2, np.array([3]), np.array([], np.int64))
+    with pytest.warns(UserWarning, match="TypeError"):
+        assert p.take(2, np.array([3])) is None
+    assert calls[0] == 1
+
+
 # --------------------------------------------------- engine-level bitwise
 
 
@@ -168,6 +218,7 @@ def test_prefetch_stream_identity_telemetry_churn(tmp_path):
         for line in open(cfg.metrics_stream):
             d = json.loads(line)
             d.pop("t", None)
+            d.pop("crc", None)  # per-line checksums differ with content
             if d.get("series") == "step_time":
                 d["value"] = {
                     k: v for k, v in d["value"].items() if k != "seconds"
@@ -222,6 +273,7 @@ def test_crash_mid_prefetch_resumes_clean(tmp_path):
         for line in open(path):
             d = json.loads(line)
             d.pop("t", None)
+            d.pop("crc", None)  # per-line checksums differ with content
             if d.get("event") == "stream_header":
                 d.pop("tag", None)  # plans differ by the crash point
             if d.get("series") == "step_time":
