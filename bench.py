@@ -301,6 +301,64 @@ def _probe_batch_probe():
     }
 
 
+def _widened_probe():
+    """Warm fused-round wall: `--client-fold gemm` vs `vmap` at P=4.
+
+    The widened-GEMM probe (docs/PERF.md §Widened GEMM): `vmap` compiles
+    today's exact probe-fan programs — every probe carries its own full
+    probe-batched parameter copy, so the MXU sees K·P skinny dots of
+    M = B each — while `gemm` re-batches the fan at the tree level so
+    probe-invariant layers run ONCE per fan and the active contraction
+    widens to M (or N) = B·P. Both folds pick the IDENTICAL alpha per
+    step (tests/test_widened.py asserts bitwise parity on CPU), so the
+    timed delta is pure dispatch shape. Measured at B=32 (the flagship's
+    skinny regime, where widening matters most per the roofline argument)
+    and B=256 (already-wide rows — the speedup's expected decay curve).
+    `effective_gemm_m` records the M the MXU sees at each point. On a
+    CPU host the expected ratio is ~1x (no MXU to starve — docs/PERF.md
+    §Re-measurement debt carries the >= 3x TPU target).
+    """
+    import numpy as np
+
+    from federated_pytorch_test_tpu.data import synthetic_cifar
+    from federated_pytorch_test_tpu.engine import Trainer, get_preset
+
+    k, probes = 3, 4
+    out = {"linesearch_probes": probes}
+    for batch in (32, 256):
+        src = synthetic_cifar(n_train=k * batch * 2, n_test=60)
+        times = {}
+        for fold_mode in ("gemm", "vmap"):
+            cfg = get_preset(
+                "fedavg", n_clients=k, batch=batch, nloop=5, nadmm=3,
+                max_groups=1, model="net", check_results=False,
+                synthetic_ok=True, linesearch_probes=probes,
+                client_fold=fold_mode,
+            )
+            tr = Trainer(cfg, verbose=False, source=src)
+            gid = tr.group_order[0]
+            tr.run_round(0, gid)  # warmup: compile-dominated
+            dts = []
+            for nloop in range(1, 4):
+                t0 = time.perf_counter()
+                tr.run_round(nloop, gid)
+                dts.append(time.perf_counter() - t0)
+            times[fold_mode] = float(np.median(dts))
+            tr.close()
+        out[f"round_time_gemm_b{batch}_s"] = round(times["gemm"], 4)
+        out[f"round_time_vmap_b{batch}_s"] = round(times["vmap"], 4)
+        # >= 1 where the widened fold pays: vmap wall over gemm wall
+        out[f"widened_gemm_speedup_b{batch}"] = round(
+            times["vmap"] / times["gemm"], 3
+        )
+        out[f"effective_gemm_m_b{batch}"] = k * probes * batch
+    # the single headline convention: the skinny-regime point (B=32) is
+    # where the fold's claim lives; B=256 rides along as the decay curve
+    out["widened_gemm_speedup"] = out["widened_gemm_speedup_b32"]
+    out["effective_gemm_m"] = out["effective_gemm_m_b32"]
+    return out
+
+
 def _exchange_probe(tr_partition, group_order, gid, k):
     """The codec zoo's ledger numbers for the measured workload
     (exchange/, obs/ledger.py): exact uplink bytes of one consensus
@@ -936,6 +994,12 @@ def main() -> None:
     except Exception as e:  # a failed probe must not kill the bench
         out["probe_batch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    # ---- the widened-GEMM probe: --client-fold gemm vs vmap rounds ----
+    try:
+        out["widened"] = _widened_probe()
+    except Exception as e:  # a failed probe must not kill the bench
+        out["widened"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # ---- the exchange-codec ledger numbers for the flagship group ----
     try:
         from federated_pytorch_test_tpu.engine import (
@@ -1159,6 +1223,18 @@ def main() -> None:
         "probe_batch_speedup": out.get("probe_batch", {}).get(
             "probe_batch_speedup"
         ),
+        # the widened-GEMM facts (ISSUE-17, docs/PERF.md §Widened GEMM):
+        # warm fused-round wall vmap/gemm at the flagship's skinny B=32
+        # (the headline claim; >= 3x is the TPU target, ~1x expected on
+        # CPU hosts), the already-wide B=256 decay point, and the M the
+        # MXU actually sees through the fold
+        "widened_gemm_speedup": out.get("widened", {}).get(
+            "widened_gemm_speedup"
+        ),
+        "widened_gemm_speedup_b256": out.get("widened", {}).get(
+            "widened_gemm_speedup_b256"
+        ),
+        "effective_gemm_m": out.get("widened", {}).get("effective_gemm_m"),
         "exchange_dtype": out.get("exchange", {}).get("exchange_dtype"),
         "bf16_comm_bytes_per_round": out.get("exchange", {}).get(
             "comm_bytes_per_round"

@@ -46,16 +46,18 @@ BATCH = 32
 STEPS = 8
 
 
-def _k_sweep(jax, jnp):
+def _k_sweep(jax, jnp, client_fold=None):
     from federated_pytorch_test_tpu.data import synthetic_cifar
     from federated_pytorch_test_tpu.engine import Trainer, get_preset
     from federated_pytorch_test_tpu.parallel import mesh_size
 
+    fold_over = {} if client_fold is None else {"client_fold": client_fold}
     rows = []
     for k in KS:
         src = synthetic_cifar(n_train=k * BATCH * STEPS, n_test=64)
         cfg = get_preset(
-            "fedavg_resnet", n_clients=k, batch=BATCH, check_results=False
+            "fedavg_resnet", n_clients=k, batch=BATCH, check_results=False,
+            **fold_over,
         )
         tr = Trainer(cfg, verbose=False, source=src)
         gid = tr.group_order[0]
@@ -110,11 +112,13 @@ def _k_sweep(jax, jnp):
                     "scaling_efficiency is PER-DEVICE throughput vs the "
                     "first row",
         "device": str(jax.devices()[0]),
+        "client_fold": client_fold or "gemm",
         "rows": rows,
     }
 
 
-def _cohort_sweep(jax, ns, cohorts, model, batch, steps, prefetch=True):
+def _cohort_sweep(jax, ns, cohorts, model, batch, steps, prefetch=True,
+                  client_fold=None):
     """Warm gather→round→scatter wall over (cohort C, population N).
 
     Per-CLIENT work is held constant across every row: the shard pool is
@@ -155,11 +159,14 @@ def _cohort_sweep(jax, ns, cohorts, model, batch, steps, prefetch=True):
                     "raise --virtual-clients or drop the largest cohort",
                 }))
                 continue
+            fold_over = (
+                {} if client_fold is None else {"client_fold": client_fold}
+            )
             cfg = get_preset(
                 "fedavg", model=model, batch=batch, check_results=False,
                 nadmm=1, nepoch=1, max_groups=1, reg_mode="none",
                 virtual_clients=n_virtual, cohort=cohort,
-                data_shards=shards, prefetch=prefetch,
+                data_shards=shards, prefetch=prefetch, **fold_over,
             )
             tr = Trainer(cfg, verbose=False, source=src)
             tr.run_loop(0)  # warmup: compile-dominated
@@ -206,6 +213,7 @@ def _cohort_sweep(jax, ns, cohorts, model, batch, steps, prefetch=True):
                     "virtual clients behind the host store",
         "device": str(jax.devices()[0]),
         "n_devices": len(jax.devices()),
+        "client_fold": client_fold or "gemm",
         "rows": rows,
     }
 
@@ -235,6 +243,13 @@ def main():
         "a _cpu suffix and the TPU re-measurement stays owed",
     )
     ap.add_argument(
+        "--client-fold", choices=["gemm", "vmap"], default=None,
+        help="widened client fold (docs/PERF.md §Widened GEMM): 'gemm' "
+        "(engine default) widens the probe fan into the example axis; "
+        "'vmap' compiles the probe-batched baseline byte-for-byte — "
+        "output gets a _vmapfold suffix so pairs sit side by side",
+    )
+    ap.add_argument(
         "--no-prefetch", action="store_true",
         help="disable the pipelined cohort prefetch for the cohort "
         "sweep (clients/prefetch.py) — measures the synchronous-gather "
@@ -250,6 +265,8 @@ def main():
 
     here = os.path.dirname(os.path.abspath(__file__))
     suffix = "" if jax.default_backend() == "tpu" else "_cpu"
+    if args.client_fold == "vmap":
+        suffix += "_vmapfold"  # baseline runs sit beside their gemm twins
     if args.virtual_clients:
         # both axes sorted ascending: the flatness ratios below are
         # defined against the smallest-N / smallest-C row of each group
@@ -257,11 +274,11 @@ def main():
         cohorts = sorted(int(v) for v in args.cohort.split(","))
         out = _cohort_sweep(
             jax, ns, cohorts, args.model, args.batch, args.steps,
-            prefetch=not args.no_prefetch,
+            prefetch=not args.no_prefetch, client_fold=args.client_fold,
         )
         path = os.path.join(here, f"cohort_scaling_tpu{suffix}.json")
     else:
-        out = _k_sweep(jax, jnp)
+        out = _k_sweep(jax, jnp, client_fold=args.client_fold)
         path = os.path.join(here, f"client_scaling_tpu{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

@@ -69,6 +69,15 @@ def main() -> None:
     # amortized parameter streaming — the roofline lever bench.py prices
     # as probe_batch_speedup)
     ap.add_argument("--linesearch-probes", type=int, default=None)
+    # widened client fold (config.client_fold, docs/PERF.md §Widened
+    # GEMM): 'gemm' (engine default) re-batches the probe fan at the
+    # tree level so frozen layers run once per fan and active
+    # contractions widen to M = B·P; 'vmap' compiles today's exact
+    # probe-batched programs byte-for-byte — the baseline the
+    # widened_gemm_speedup claim is measured against
+    ap.add_argument(
+        "--client-fold", choices=["gemm", "vmap"], default=None
+    )
     # exchange wire codec (config.exchange_dtype, exchange/): 'bfloat16'
     # halves every exchange's uplink bytes; the recorded comm series and
     # summary show the wire bytes exactly
@@ -99,6 +108,8 @@ def main() -> None:
         over["linesearch_probes"] = args.linesearch_probes
     if args.exchange_dtype is not None:
         over["exchange_dtype"] = args.exchange_dtype
+    if args.client_fold is not None:
+        over["client_fold"] = args.client_fold
     if args.stream:
         over.update(hbm_data_budget_mb=0, stream_chunk_steps=8)
     if args.real_archive:
@@ -207,6 +218,7 @@ def main() -> None:
         "compile_cache": args.compile_cache,
         # the roofline knobs this schedule ran under (docs/PERF.md)
         "linesearch_probes": cfg.linesearch_probes,
+        "client_fold": cfg.client_fold,
         "exchange_dtype": cfg.exchange_dtype,
         # the communication ledger (obs/ledger.py): exact per-exchange
         # uplink bytes and the end-of-run summary comparing the partial-
@@ -262,6 +274,8 @@ def main() -> None:
         suffix += "_bf16x"  # codec runs sit beside their f32 baselines
     if cfg.linesearch_probes != 1:
         suffix += f"_p{cfg.linesearch_probes}"
+    if cfg.client_fold == "vmap":
+        suffix += "_vmapfold"  # the widened-GEMM comparison baseline
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         f"full_{args.preset}{suffix}_tpu.json",

@@ -304,6 +304,10 @@ def _print_summary(recorder, cfg) -> None:
     roof = recorder.latest("roofline")
     if roof is not None:
         line = f"# roofline: wall {roof['wall_s']}s/round"
+        if "client_fold" in roof:
+            line += f", fold {roof['client_fold']}"
+        if "effective_gemm_m" in roof:
+            line += f", GEMM M {roof['effective_gemm_m']}"
         if "arithmetic_intensity" in roof:
             line += f", intensity {roof['arithmetic_intensity']}"
         if "mfu" in roof:

@@ -215,6 +215,19 @@ class ExperimentConfig:
     # per step each re-stream the full parameter vector from HBM; a fan
     # streams once per P probes (bench.py probe_batch_speedup).
     linesearch_probes: int = 1
+    # widened client GEMM (engine/steps.py GroupContext.client_fold,
+    # docs/PERF.md §Widened GEMM): 'gemm' (the default) re-batches the
+    # probe fan at the params-tree level so frozen layers fold the P
+    # alpha axis into their GEMM M dimension (the MXU sees M = K·P·B
+    # across the client vmap instead of K·P skinny M=B dots) and the
+    # probe-invariant prefix runs once per fan; 'vmap' is the escape
+    # hatch that compiles today's exact probe-fan programs
+    # byte-for-byte. Same objective values, but the wide reduction may
+    # reorder, so like linesearch_probes this is a TRAJECTORY-CHANGING
+    # knob and lives in the metrics-stream tag. Inert at
+    # linesearch_probes=1 (no fan is ever built — both modes compile
+    # the identical sequential-search program).
+    client_fold: str = "gemm"
 
     # ADMM (reference src/consensus_admm_trio.py:23,37-44)
     admm_rho0: float = 1e-3
@@ -653,6 +666,11 @@ class ExperimentConfig:
         if self.linesearch_probes < 1:
             raise ValueError(
                 f"linesearch_probes must be >= 1, got {self.linesearch_probes}"
+            )
+        if self.client_fold not in ("gemm", "vmap"):
+            raise ValueError(
+                f"client_fold must be 'gemm' or 'vmap', "
+                f"got {self.client_fold!r}"
             )
         if self.exchange_dtype not in EXCHANGE_DTYPES:
             raise ValueError(

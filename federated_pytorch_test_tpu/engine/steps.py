@@ -87,6 +87,7 @@ from federated_pytorch_test_tpu.consensus import (
 )
 from federated_pytorch_test_tpu.data import normalize
 from federated_pytorch_test_tpu.exchange import make_codec
+from federated_pytorch_test_tpu.models.base import active_leaf_mask, fold_params
 from federated_pytorch_test_tpu.parallel.diagnostics import group_distances
 from federated_pytorch_test_tpu.optim import (
     LBFGSConfig,
@@ -212,11 +213,40 @@ class GroupContext(NamedTuple):
     # property holds with the signal in-program. Static: roundrobin
     # runs compile the exact pre-drift programs.
     group_drift: bool = False
+    # widened client GEMM (docs/PERF.md §Widened GEMM): how the probe
+    # fan's alpha axis meets the model's dots. 'vmap' batches the WHOLE
+    # params tree along the fan — XLA lowers every layer to P skinny
+    # batched dots with M=B — and compiles today's exact programs
+    # byte-for-byte. 'gemm' re-batches at the tree level: only the
+    # ACTIVE group's leaves ride the fan (models/base.py fold_params);
+    # every frozen layer's dot then folds the P axis into its M
+    # dimension (M = P·B per client, M = K·P·B across the client vmap)
+    # and the probe-invariant prefix below the first active layer is
+    # computed ONCE for all probes. Same values — vmap's dot_general
+    # batching rule only restructures the contraction — but the wide
+    # reduction may reorder, so 'gemm' is parity-gated to documented
+    # ulps (tests/test_widened.py) and joins the stream tag. Static:
+    # the default keeps hand-built contexts on the unchanged programs;
+    # the ENGINE default is 'gemm' (engine/config.py client_fold).
+    client_fold: str = "vmap"
 
 
 def _data_loss(ctx: GroupContext, flat: jnp.ndarray, stats: PyTree, images, labels):
     """One client's CE loss (+ updated batch stats) at full flat params."""
-    params = ctx.unravel(flat)
+    return _tree_data_loss(ctx, ctx.unravel(flat), stats, images, labels)
+
+
+def _tree_data_loss(ctx: GroupContext, params: PyTree, stats: PyTree,
+                    images, labels):
+    """`_data_loss` at an already-unraveled params TREE.
+
+    The tree-level entry exists for the widened-GEMM fan
+    (`client_fold='gemm'`): there the params tree is assembled by
+    `fold_params` — active-group leaves probe-batched, frozen leaves
+    unbatched — rather than by one `unravel` call, and THIS body is
+    what both assemblies share, so the two fold modes run the identical
+    loss ops on identical values.
+    """
     collections = []
     if ctx.has_stats:
         collections.append("batch_stats")
@@ -307,18 +337,49 @@ def _client_train_step(ctx: GroupContext):
         and (ctx.diag_forward or ctx.has_stats)
     )
 
+    # WIDENED client GEMM (`client_fold='gemm'`, docs/PERF.md §Widened
+    # GEMM): the default probe fan vmaps the WHOLE `phi_aux` — because
+    # `objective` inserts the probed x into the full flat and unravels,
+    # EVERY leaf (frozen layers included) arrives probe-batched, and XLA
+    # lowers each layer to P skinny batched dots with M=B. The fan built
+    # here re-batches at the TREE level instead: the probed unravel
+    # contributes only the ACTIVE group's leaves (they genuinely vary
+    # along the fan), every other leaf comes from `unravel(base)` closed
+    # over OUTSIDE the alpha vmap. vmap's dot_general batching rule then
+    # folds the fan axis into the frozen layers' M dimension, and the
+    # probe-invariant prefix below the first active layer is computed
+    # once for all P probes. Values are the inserted full vector's
+    # either way (the frozen coordinates of `insert(base, gid, xc)` ARE
+    # `base`'s bits), so the fan computes the same objective — only the
+    # reduction structure of the widened dots may reorder (documented
+    # ulps, tests/test_widened.py). Static per (group, fold mode): off
+    # when probes==1, where the sequential search never builds a fan.
+    fan_gemm = (
+        ctx.client_fold == "gemm"
+        and ctx.lbfgs.line_search
+        and ctx.lbfgs.batch_mode
+        and ctx.lbfgs.ls_probes > 1
+    )
+    leaf_mask = (
+        active_leaf_mask(ctx.unravel, ctx.partition, ctx.gid)
+        if fan_gemm
+        else None
+    )
+
     def step(flat, lstate, stats, images_u8, labels, mean, std, y, z, rho):
         images = normalize(images_u8, mean, std)
         base = flat.astype(model_dt) if hoist_cast else flat
 
-        def objective(x):
+        def objective_with(params_of, x):
             # substituting the active group into the PRE-CAST remainder is
             # numerically identical to casting inside: the frozen
             # coordinates round f32->bf16 the same either way, and x's
             # own cast keeps the gradient path to f32 x
             xc = x.astype(model_dt) if hoist_cast else x
             full = ctx.partition.insert(base, ctx.gid, xc)
-            data_loss, new_stats = _data_loss(ctx, full, stats, images, labels)
+            data_loss, new_stats = _tree_data_loss(
+                ctx, params_of(full), stats, images, labels
+            )
             loss = data_loss
             if ctx.reg_segments and hoist_cast:
                 # fixed-segment elastic net reads FROZEN coordinates of
@@ -332,6 +393,9 @@ def _client_train_step(ctx: GroupContext):
                 loss = loss + admm_penalty(x, y, z, rho)
             return loss, (data_loss, new_stats)
 
+        def objective(x):
+            return objective_with(ctx.unravel, x)
+
         if fold:
             loss_fn = objective
         else:
@@ -343,9 +407,29 @@ def _client_train_step(ctx: GroupContext):
             # every line-search probe is forward-only and unaffected
             loss_fn = jax.checkpoint(loss_fn)
 
+        if fan_gemm:
+            # frozen leaves evaluated OUTSIDE the alpha vmap — closing
+            # over them unbatched is what lets vmap widen M; XLA
+            # dead-code-eliminates the probed unravel's unused slices
+            frozen = ctx.unravel(base)
+
+            def params_of(full):
+                return fold_params(ctx.unravel(full), frozen, leaf_mask)
+
+            def fan_fn(x_cur, d, alphas):
+                def phi(alpha):
+                    loss, aux = objective_with(params_of, x_cur + alpha * d)
+                    # mirror lbfgs_step's loss_fn_aux contract: the fan's
+                    # aux structure must match the sequential path's
+                    return (loss, aux) if fold else (loss, ())
+
+                return jax.vmap(phi)(alphas)
+        else:
+            fan_fn = None
+
         x0 = ctx.partition.extract(flat, ctx.gid)
         x1, lstate, aux = lbfgs_step(
-            loss_fn, x0, lstate, ctx.lbfgs, has_aux=fold
+            loss_fn, x0, lstate, ctx.lbfgs, has_aux=fold, fan_fn=fan_fn
         )
         flat = ctx.partition.insert(flat, ctx.gid, x1)
         if fold:

@@ -957,6 +957,7 @@ class Trainer:
             quant_bits=cfg.quant_bits,
             error_feedback=self._ef_enabled(),
             group_drift=self._adaptive,
+            client_fold=cfg.client_fold,
         )
 
     def _quarantine_enabled(self) -> bool:
@@ -2348,7 +2349,14 @@ class Trainer:
             group=gid,
         )
         if self.recorder.tracer is not None:
-            self.recorder.tracer.counter("dispatches", self._dispatch.counts)
+            # fold-mode-tagged counter track: Perfetto traces from a
+            # 'gemm' and a 'vmap' run are distinguishable at a glance
+            # (ISSUE-17 satellite; the dispatch_count METRIC categories
+            # above stay untagged — every {round: 1} budget gate keys
+            # on them)
+            self.recorder.tracer.counter(
+                f"dispatches:{self.cfg.client_fold}", self._dispatch.counts
+            )
         self.recorder.flush()
         if self.store is not None:
             # storage_fault incident (docs/FAULT.md §Storage-integrity
@@ -2586,7 +2594,8 @@ class Trainer:
                 self._step_num += 1
                 per_batch_eval = cfg.check_results and cfg.eval_every_batch
                 with self.recorder.phase(
-                    "epoch", nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch
+                    "epoch", nloop=nloop, group=gid, nadmm=nadmm, epoch=epoch,
+                    client_fold=cfg.client_fold,
                 ), jax.profiler.StepTraceAnnotation(
                     "epoch", step_num=self._step_num
                 ):
@@ -2941,7 +2950,8 @@ class Trainer:
         )
         self._step_num += cfg.nadmm * cfg.nepoch
         with self.recorder.phase(
-            "fused_round", nloop=nloop, group=gid
+            "fused_round", nloop=nloop, group=gid,
+            client_fold=cfg.client_fold,
         ), jax.profiler.StepTraceAnnotation(
             "fused_round", step_num=self._step_num
         ):
@@ -3262,6 +3272,12 @@ class Trainer:
             except (OSError, ValueError):
                 doc = {}
             doc["completed" if self._run_completed else "crashed"] = True
+            # the end-of-run roofline (fold mode + effective GEMM M
+            # included) is stream=False like every process fact — the
+            # `watch` console renders it from here
+            roof = self.recorder.latest("roofline")
+            if roof is not None:
+                doc["roofline"] = roof
             if self.store is not None:
                 # the final residency digest: the per-round sidecar was
                 # last written BEFORE the closing scatter/save, and a
@@ -3402,18 +3418,26 @@ class Trainer:
             ]
             if not walls:
                 continue
-            self.recorder.log(
-                "roofline",
-                roofline_record(
-                    wall_s=float(np.median(walls)),
-                    flops=cost.get("flops"),
-                    hbm_bytes=cost.get("hbm_bytes"),
-                    device_kind=jax.devices()[0].device_kind,
-                    source=cost.get("source", "measured"),
-                ),
-                stream=False,
-                group=gid,
+            rec = roofline_record(
+                wall_s=float(np.median(walls)),
+                flops=cost.get("flops"),
+                hbm_bytes=cost.get("hbm_bytes"),
+                device_kind=jax.devices()[0].device_kind,
+                source=cost.get("source", "measured"),
             )
+            # the intensity claim as a recorded number, not prose
+            # (ISSUE-17): what M the MXU sees through the probe fan.
+            # 'gemm' folds the fan into the example axis — M = K·P·B
+            # rows feed one widened contraction per frozen layer —
+            # while 'vmap' (and any probe-less config, where no fan
+            # exists to fold) lowers to K·P skinny dots of M = B each.
+            rec["client_fold"] = cfg.client_fold
+            rec["effective_gemm_m"] = int(
+                cfg.n_clients * cfg.batch * cfg.linesearch_probes
+                if cfg.client_fold == "gemm" and cfg.linesearch_probes > 1
+                else cfg.batch
+            )
+            self.recorder.log("roofline", rec, stream=False, group=gid)
         if self._cohort_mode:
             # per-virtual-client participation digest — pure in
             # (cohort_seed, nloop), so a crashed-and-resumed run records

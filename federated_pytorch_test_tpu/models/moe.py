@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from federated_pytorch_test_tpu.models.base import bias_init, kernel_init
+from federated_pytorch_test_tpu.ops import grouped_matmul
 
 # the axis's mesh/sharding idiom lives with the other axes' in parallel/
 from federated_pytorch_test_tpu.parallel.expert import (  # noqa: F401
@@ -111,13 +112,16 @@ class MoEMLP(nn.Module):
             "tec,td->ecd", dispatch, xt.astype(jnp.float32)
         ).astype(self.dtype)  # [E, C, D]
 
-        # --- expert MLPs: stacked [E, ...] params, vmapped over E ---
+        # --- expert MLPs: stacked [E, ...] params, one grouped GEMM per
+        # projection (ops/grouped_gemm.py — the [E, C, D] x [E, D, H]
+        # block contraction; einsum backend, bitwise-identical to the
+        # vmap-over-E formulation it replaced, tests/test_widened.py) ---
         h = self.mlp_ratio * d
 
-        def mlp(x_e, w1, b1, w2, b2):
-            y = jnp.einsum("cd,dh->ch", x_e, w1) + b1
+        def mlp_grouped(x_e, w1, b1, w2, b2):
+            y = grouped_matmul(x_e, w1) + b1[:, None, :]
             y = nn.gelu(y)
-            return jnp.einsum("ch,hd->cd", y, w2) + b2
+            return grouped_matmul(y, w2) + b2[:, None, :]
 
         w1 = self.param(
             "w1", nn.initializers.xavier_uniform(), (e, d, h), jnp.float32
@@ -131,7 +135,7 @@ class MoEMLP(nn.Module):
         b2 = self.param(
             "b2", nn.initializers.constant(0.01), (e, d), jnp.float32
         ).astype(self.dtype)
-        expert_out = jax.vmap(mlp)(expert_in, w1, b1, w2, b2)  # [E, C, D]
+        expert_out = mlp_grouped(expert_in, w1, b1, w2, b2)  # [E, C, D]
 
         combine = dispatch * gate[:, None, None]  # [T, E, C]
         out = jnp.einsum(

@@ -131,6 +131,7 @@ class ResNet18(PartitionedModel):
     )
     LINEAR_GROUP_IDS = ()  # resnet drivers apply no L1/L2 in their closures
     TRAIN_ORDER = tuple(range(10))  # drivers use np.random.permutation at runtime
+    FOLD_LAYERS = {"conv": "free", "norm": "free", "dense": "grouped"}
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:

@@ -53,6 +53,7 @@ class Net(PartitionedModel):
     )
     LINEAR_GROUP_IDS = (2, 3, 4)  # reference src/simple_models.py:29-30
     TRAIN_ORDER = (2, 0, 1, 3, 4)  # reference src/simple_models.py:38-39
+    FOLD_LAYERS = {"conv": "free", "dense": "grouped"}
 
     num_classes: int = 10
 
@@ -76,6 +77,7 @@ class Net1(PartitionedModel):
     )
     LINEAR_GROUP_IDS = (4, 5)  # reference src/simple_models.py:69-70
     TRAIN_ORDER = (2, 5, 1, 3, 0, 4)  # reference src/simple_models.py:78-79
+    FOLD_LAYERS = {"conv": "free", "dense": "grouped"}
 
     num_classes: int = 10
 
@@ -112,6 +114,7 @@ class Net2(PartitionedModel):
     )
     LINEAR_GROUP_IDS = (4, 5, 6, 7, 8)  # reference src/simple_models.py:119-120
     TRAIN_ORDER = (7, 2, 1, 4, 8, 6, 3, 0, 5)  # reference src/simple_models.py:130-131
+    FOLD_LAYERS = {"conv": "free", "dense": "grouped"}
 
     num_classes: int = 10
 

@@ -222,6 +222,10 @@ class TransformerLM(PartitionedModel):
     )
     LINEAR_GROUP_IDS = (5,)
     TRAIN_ORDER = (0, 1, 2, 3, 4, 5)
+    FOLD_LAYERS = {
+        "embed": "free", "norm": "free",
+        "dense": "grouped", "attn": "grouped", "expert": "grouped",
+    }
 
     vocab: int = 256
     dim: int = 64
@@ -304,6 +308,10 @@ class ViT(PartitionedModel):
     )
     LINEAR_GROUP_IDS = (5,)
     TRAIN_ORDER = (0, 1, 2, 3, 4, 5)
+    FOLD_LAYERS = {
+        "embed": "free", "norm": "free",
+        "dense": "grouped", "attn": "grouped",
+    }
 
     num_classes: int = 10
     dim: int = 64

@@ -259,6 +259,23 @@ def _render_run(name: str, run: RunStream) -> List[str]:
                     f"injected faults {status['storage_faults']}"
                 )
             lines.append("   integrity " + " | ".join(parts))
+        roof = status.get("roofline")
+        if isinstance(roof, dict):
+            # end-of-run roofline (obs/roofline.py, docs/PERF.md §Widened
+            # GEMM): fold mode + the M the MXU actually saw, then the
+            # achieved-vs-peak verdict when the chip is known
+            parts = [f"fold {roof.get('client_fold', '?')}"]
+            if roof.get("effective_gemm_m") is not None:
+                parts.append(f"GEMM M {roof['effective_gemm_m']}")
+            if roof.get("arithmetic_intensity") is not None:
+                parts.append(
+                    f"intensity {roof['arithmetic_intensity']} flop/B"
+                )
+            if roof.get("mfu") is not None:
+                parts.append(f"MFU {roof['mfu']:.2%}")
+            if roof.get("bound"):
+                parts.append(f"{roof['bound']}-bound")
+            lines.append("   roofline " + " | ".join(parts))
     bundles = list_incidents(run.path)
     if bundles:
         names = []

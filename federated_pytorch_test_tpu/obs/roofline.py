@@ -65,6 +65,7 @@ def lbfgs_round_cost(
     nepoch: int = 1,
     nadmm: int = 1,
     ls_probes: int = 1,
+    client_fold: str = "gemm",
     func_evals_per_step: Optional[float] = None,
     model_flops_per_sample: Optional[float] = None,
     batch: Optional[int] = None,
@@ -79,10 +80,16 @@ def lbfgs_round_cost(
       `1 + max_iter` — the floor of one value_and_grad per inner
       iteration plus the entry evaluation; pass the measured
       `mean_func_evals_per_step` (bench.py) for honest numbers (the
-      Armijo search's extra probes are real traffic). A probe fan
-      (`ls_probes` > 1) streams the parameters ONCE per widened pass —
-      the amortization `--linesearch-probes` exists for — so the
-      per-eval stream is divided by the fan width for the probe share.
+      Armijo search's extra probes are real traffic). Under the widened
+      fold (`client_fold='gemm'`) a probe fan (`ls_probes` > 1) streams
+      the parameters ONCE per widened pass — the amortization
+      `--linesearch-probes` exists for — so the per-eval stream is
+      divided by the fan width for the probe share. `client_fold='vmap'`
+      gets NO such credit: there every probe carries its own full
+      probe-batched parameter copy through the model (the whole tree is
+      fan-batched), i.e. P independent parameter streams — the modeling
+      bug this argument used to have (ISSUE-17 satellite: the old model
+      amortized the fan unconditionally).
     * each of the `max_iter` inner iterations streams the 2·m-vector
       L-BFGS history (the compact/two-loop recursion's dominant reads)
       plus ~2·n of iterate/direction writes, costing ~8·m·n BLAS1 FLOPs.
@@ -104,10 +111,14 @@ def lbfgs_round_cost(
         else 1 + max_iter
     )
     # parameter streams: read params + write grads per evaluation; a
-    # P-wide probe fan shares one parameter read across its P probes
+    # P-wide probe fan shares one parameter read across its P probes —
+    # but only when the fold re-batches at the tree level ('gemm');
+    # the 'vmap' fan batches the whole parameter tree along P, so each
+    # probe streams its own full copy
     probe_share = max(0.0, fe - (1 + max_iter))
     base_evals = fe - probe_share
-    param_vals = (base_evals + probe_share / max(1, int(ls_probes))) * 2 * n
+    shared = int(ls_probes) if client_fold == "gemm" else 1
+    param_vals = (base_evals + probe_share / max(1, shared)) * 2 * n
     history_vals = max_iter * (2 * m * n + 2 * n)
     step_bytes = (param_vals + history_vals) * dtype_bytes
     step_flops = max_iter * 8.0 * m * n
@@ -115,12 +126,13 @@ def lbfgs_round_cost(
     if model_flops_per_sample is not None and batch:
         model_flops = fe * float(batch) * float(model_flops_per_sample)
     mult = int(steps) * int(nepoch) * int(nadmm) * int(k_clients)
-    return {
+    out = {
         "source": "analytic",
         "n_params": n,
         "lbfgs_history": m,
         "lbfgs_max_iter": int(max_iter),
         "ls_probes": int(ls_probes),
+        "client_fold": client_fold,
         "func_evals_per_step": round(fe, 3),
         "steps_per_round": mult,
         "hbm_bytes": float(step_bytes * mult),
@@ -128,6 +140,17 @@ def lbfgs_round_cost(
         # without model FLOPs the total is the optimizer's BLAS1 floor
         "model_flops_included": bool(model_flops),
     }
+    if batch:
+        # what M the MXU sees through the probe fan (the widened-GEMM
+        # intensity claim as a number): the fold merges K·P·B example
+        # rows into one contraction per frozen layer; without it each
+        # of the K·P skinny dots carries M = B
+        out["effective_gemm_m"] = int(
+            int(k_clients) * int(ls_probes) * int(batch)
+            if client_fold == "gemm" and int(ls_probes) > 1
+            else int(batch)
+        )
+    return out
 
 
 def roofline_record(

@@ -15,9 +15,15 @@ from federated_pytorch_test_tpu.ops.compact_pallas import (
     fused_gram_projections,
 )
 from federated_pytorch_test_tpu.ops.flash_attention import flash_attention
+from federated_pytorch_test_tpu.ops.grouped_gemm import (
+    grouped_matmul,
+    grouped_matmul_pallas,
+)
 
 __all__ = [
     "compact_direction_pallas",
     "flash_attention",
     "fused_gram_projections",
+    "grouped_matmul",
+    "grouped_matmul_pallas",
 ]

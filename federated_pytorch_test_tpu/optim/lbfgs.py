@@ -291,6 +291,7 @@ def lbfgs_step(
     state: LBFGSState,
     config: LBFGSConfig,
     has_aux: bool = False,
+    fan_fn=None,
 ) -> Tuple[jnp.ndarray, LBFGSState, LBFGSAux]:
     """One optimizer step: up to `max_iter` L-BFGS iterations with line search.
 
@@ -311,6 +312,14 @@ def lbfgs_step(
     `batch_mode` + `line_search`. `LBFGSAux.aux_ok` is False only when
     the final x came from the NaN-step-size fallback AND was never
     re-evaluated — callers must keep their previous aux then.
+
+    `fan_fn`, when given, is the widened probe-fan evaluator
+    `fan_fn(x, d, alphas) -> (losses, auxs)` handed to the multi-alpha
+    Armijo search as its `fan_phi` (linesearch.py) — it must compute the
+    same values as `vmap(phi_aux)` over the fan, only batched
+    differently (the `--client-fold gemm` hook, engine/steps.py). Only
+    consulted when `ls_probes > 1`; `None` compiles today's exact
+    programs byte-for-byte.
     """
     if has_aux and not (config.batch_mode and config.line_search):
         raise ValueError(
@@ -453,6 +462,10 @@ def lbfgs_step(
                     t_ls, ls_ev, aux_ls = backtracking_armijo_probes_aux(
                         phi_aux, c.loss, gtd, alphabar,
                         probes=config.ls_probes,
+                        fan_phi=(
+                            (lambda alphas: fan_fn(x_cur, d, alphas))
+                            if fan_fn is not None else None
+                        ),
                     )
                 else:
                     t_ls, ls_ev, aux_ls = backtracking_armijo_aux(

@@ -134,6 +134,7 @@ def backtracking_armijo_probes_aux(
     c1: float = 1e-4,
     max_iters: int = 35,
     probes: int = 4,
+    fan_phi=None,
 ):
     """Batched multi-alpha Armijo: `probes` candidate steps per widened pass.
 
@@ -169,6 +170,17 @@ def backtracking_armijo_probes_aux(
 
     vmap-safe like the sequential loop: a client whose fan already
     accepted keeps its carry frozen while siblings keep fanning.
+
+    `fan_phi`, when given, replaces the default widened evaluation
+    `jax.vmap(phi_aux)(alphas)` with `fan_phi(alphas) -> (losses, auxs)`
+    over the `[P]` alpha fan. It MUST compute the same values as the
+    default (same objective, same aux structure) — only the batching
+    structure may differ. This is the widened-GEMM hook
+    (`--client-fold gemm`, engine/steps.py): the engine's fan keeps the
+    frozen partition groups' parameters UNBATCHED along the probe axis,
+    so XLA's vmap batching rules fold the P axis into the matmul M
+    dimension instead of emitting P skinny per-probe dots. `None`
+    compiles today's exact fan byte-for-byte.
     """
     if probes < 1:
         raise ValueError(f"probes must be >= 1, got {probes}")
@@ -183,7 +195,10 @@ def backtracking_armijo_probes_aux(
     def fan_eval(base, j0):
         """One widened pass over `probes` consecutive rungs from `base`."""
         alphas = base * (0.5**offsets)
-        losses, auxs = jax.vmap(phi_aux)(alphas)
+        if fan_phi is not None:
+            losses, auxs = fan_phi(alphas)
+        else:
+            losses, auxs = jax.vmap(phi_aux)(alphas)
         rung = j0 + jnp.arange(probes, dtype=jnp.int32)
         valid = rung < n_rungs
         ok = valid & ~(losses > f_old + alphas * prod)

@@ -99,7 +99,20 @@
 #                                    stream identity, transient-ioerror
 #                                    write plan survived via retry,
 #                                    scrub detect-then-repair, nonzero
-#                                    storage_faults= scoreboard)
+#                                    storage_faults= scoreboard) and
+#                                    widened_smoke (the widened client
+#                                    GEMM — docs/PERF.md §Widened GEMM:
+#                                    --client-fold gemm with a P=4 probe
+#                                    fan under dropout+corruption +
+#                                    trimmed(1) + topk codec, planned
+#                                    crash recovered via rerun with twin
+#                                    stream identity and the per-round
+#                                    {round: 1} dispatch budget held on
+#                                    the stream, then a --client-fold
+#                                    vmap rerun whose stream matches the
+#                                    gemm twin's bitwise modulo the
+#                                    fold-mode tag — the documented
+#                                    CPU tolerance)
 #
 # Every tier starts with a PREFLIGHT stray-process check (see
 # preflight() below): the tier-1 wall sits within ~10 s of the driver's
@@ -1033,6 +1046,109 @@ open(p, 'wb').write(bytes(b))"
     cat "$d/scrub3.out" >&2; rm -rf "$d"; return 1
   }
   echo "integrity smoke OK"
+  rm -rf "$d"
+}
+
+widened_smoke() {
+  # Widened client GEMM through the REAL CLI (engine/steps.py,
+  # ops/grouped_gemm.py, docs/PERF.md §Widened GEMM): a P=4 probe fan
+  # under --client-fold gemm — the fold that turns the K-client x
+  # P-probe fan into one wide contraction — with a dropout+corruption
+  # plan, trimmed(1), and the topk codec riding the exchange, and a
+  # planned crash at (nloop=1, gid=2, nadmm=0) killing the first run.
+  # Recovery is rerunning the IDENTICAL command; an uninterrupted twin
+  # proves crashed+resumed stream identity, with the per-round
+  # {round: 1} dispatch budget asserted ON THE STREAM (the fold must
+  # not cost a dispatch). Then the escape hatch: a --client-fold vmap
+  # rerun of the twin's exact plan, whose stream must match the gemm
+  # twin's within the documented tolerance — on the CPU twin that
+  # tolerance is BITWISE (docs/PERF.md fallback matrix) modulo the
+  # fold-mode tag the step_time/epoch records deliberately carry and
+  # the stream-tag header (the knob is a tag member).
+  local d; d="$(mktemp -d)"
+  local common=(python -m federated_pytorch_test_tpu --preset fedavg --quiet
+    --synthetic-n-train 240 --synthetic-n-test 60 --batch 40
+    --nloop 2 --nadmm 2 --max-groups 1 --eval-batch 30
+    --linesearch-probes 4
+    --exchange-codec topk --topk-fraction 0.1
+    --robust-agg trimmed --robust-f 1
+    --fault-mode rollback --save-model --resume auto)
+  local plan="seed=8,dropout=0.3,corrupt=1:gauss:0.5"
+  local cmd=("${common[@]}" --client-fold gemm
+    --fault-plan "$plan,crash=1:2:0"
+    --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
+  local twin=("${common[@]}" --client-fold gemm
+    --fault-plan "$plan"
+    --checkpoint-dir "$d/ckpt_twin" --metrics-stream "$d/twin.jsonl")
+  local vmapped=("${common[@]}" --client-fold vmap
+    --fault-plan "$plan"
+    --checkpoint-dir "$d/ckpt_vmap" --metrics-stream "$d/vmap.jsonl")
+  echo "widened smoke: expecting the planned crash..."
+  if "${cmd[@]}" > "$d/run1.log" 2>&1; then
+    echo "widened smoke FAILED: the planned crash never fired" >&2
+    tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
+  fi
+  echo "widened smoke: resuming..."
+  "${cmd[@]}" > "$d/run2.log" 2>&1 || {
+    echo "widened smoke FAILED: resume did not finish" >&2
+    tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
+  }
+  "${twin[@]}" > "$d/twin.log" 2>&1 || {
+    echo "widened smoke FAILED: the uninterrupted twin did not finish" >&2
+    tail -20 "$d/twin.log" >&2; rm -rf "$d"; return 1
+  }
+  assert_stream_identity "$d/run.jsonl" "$d/twin.jsonl" '
+dc = [d for d in recs if d.get("series") == "dispatch_count"]
+assert dc, "no dispatch_count records"
+# the fold must not cost a dispatch: every round is ONE round dispatch
+# (plus the first round its init), faults+trimmed+topk live inside it
+assert all(d["value"].get("round") == 1 for d in dc), dc
+assert not any(d["value"].get("epoch") for d in dc), dc
+st = [d for d in recs if d.get("series") == "step_time"]
+assert any(d["value"]["phase"] == "fused_round" for d in st), "not fused"
+assert all(
+    d.get("client_fold") == "gemm"
+    for d in st if d["value"]["phase"] == "fused_round"
+), "fused_round spans not tagged with the fold mode"
+summ = [d for d in recs if d.get("series") == "comm_summary"][-1]["value"]
+assert summ["codec"]["label"] == "topk(0.1)", summ
+' || {
+    echo "widened smoke FAILED: crashed+resumed stream differs from twin" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "widened smoke: vmap escape-hatch rerun..."
+  "${vmapped[@]}" > "$d/vmap.log" 2>&1 || {
+    echo "widened smoke FAILED: the vmap rerun did not finish" >&2
+    tail -20 "$d/vmap.log" >&2; rm -rf "$d"; return 1
+  }
+  # the cross-fold compare: same normalization as assert_stream_identity
+  # PLUS the fold-mode tag (step_time/epoch records carry client_fold by
+  # design — it is the ONLY legitimate cross-fold difference on CPU)
+  python - "$d/twin.jsonl" "$d/vmap.jsonl" <<'PY' || {
+import json, sys
+
+def norm(path):
+    out = []
+    for line in open(path):
+        d = json.loads(line)
+        d.pop("t", None)
+        d.pop("crc", None)
+        d.pop("client_fold", None)
+        if d.get("event") == "stream_header":
+            d.pop("tag", None)
+        if d.get("series") == "step_time":
+            d["value"] = {k: v for k, v in d["value"].items() if k != "seconds"}
+        out.append(d)
+    return out
+
+a, b = norm(sys.argv[1]), norm(sys.argv[2])
+assert a == b, f"gemm vs vmap streams differ: {len(a)} vs {len(b)} records"
+print(f"widened smoke: gemm == vmap over {len(a)} records (CPU bitwise)")
+PY
+    echo "widened smoke FAILED: vmap stream differs from gemm beyond the fold tag" >&2
+    rm -rf "$d"; return 1
+  }
+  echo "widened smoke OK"
   rm -rf "$d"
 }
 

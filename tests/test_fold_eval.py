@@ -218,6 +218,10 @@ def test_deferred_records_land_before_their_commit_marker(runs):
     assert seen_markers == list(range(cfg.nloop))
 
 
+# slow tier per the PR-9 rule: the admm+BB legs ride the slow tier (two
+# extra program compiles, ~17 s) — the tier-1 wall sits at the 870 s
+# driver budget; the fedavg fold/sync trajectory legs above stay tier-1
+@pytest.mark.slow
 def test_admm_bb_trajectory_identical_folded_vs_sync():
     outs = {}
     for mode in ("folded", "sync"):

@@ -52,8 +52,14 @@ def _series(rec, name):
     [
         ("fedavg", dict(nadmm=2)),
         # nadmm=3 with BB on crosses a due BB step (period 2) inside the
-        # fused scan — the trickiest consensus state to keep bit-equal
-        ("admm", dict(nadmm=3, bb_update=True)),
+        # fused scan — the trickiest consensus state to keep bit-equal.
+        # Slow tier per the PR-9 rule (admm legs ride the slow tier:
+        # two extra program compiles, ~17 s, and the tier-1 wall sits
+        # at the 870 s driver budget); the fedavg leg keeps the
+        # fused==unfused contract in tier 1
+        pytest.param(
+            "admm", dict(nadmm=3, bb_update=True), marks=pytest.mark.slow
+        ),
     ],
 )
 def test_fused_matches_unfused_bit_identical(preset, over):
