@@ -931,12 +931,20 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "32"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     dtype = os.environ.get("BENCH_DTYPE", "float32")
+    # BENCH_MODEL swaps the flagship model (models.MODELS key) — the
+    # trend smoke runs the tiny "net" CNN through the identical timing
+    # path in seconds where the resnet18 L-BFGS epoch costs minutes on
+    # the CPU twin. An overridden run is a DIFFERENT workload: the
+    # headline metric is renamed to carry the model, so the row can
+    # never append to (or judge) the resnet18 trajectory downstream,
+    # and vs_baseline is omitted.
+    model_override = os.environ.get("BENCH_MODEL") or None
 
     device_kind = jax.devices()[0].device_kind
     peak_tflops, peak_gbps = _peaks(device_kind)
 
     # ---- the flagship metric (reference workload, like for like) ----
-    flag = _measure("fedavg_resnet", None, batch, steps, dtype,
+    flag = _measure("fedavg_resnet", model_override, batch, steps, dtype,
                     peak_tflops, peak_gbps)
 
     ref_path = os.path.join(
@@ -948,21 +956,35 @@ def main() -> None:
     # BENCH_BATCH override changes the workload, so the ratio would not
     # compare like for like — omit it rather than inflate it
     vs_baseline = None
-    if batch == 32 and os.path.exists(ref_path):
+    if model_override is None and batch == 32 and os.path.exists(ref_path):
         with open(ref_path) as f:
             ref = json.load(f)
         ref_sps = ref.get("samples_per_sec")
         if ref_sps:
             vs_baseline = flag["samples_per_sec"] / ref_sps
 
+    # the provenance stamp (obs/provenance.py): every number this
+    # process emits says where it came from — backend, chip, commit,
+    # host, repeats. The trend layer keys its regression baselines on
+    # the stamp's class, so a CPU-twin session can never masquerade as
+    # a TPU measurement downstream.
+    from federated_pytorch_test_tpu.obs.provenance import provenance_stamp
+
+    stamp = provenance_stamp(repeats=flag.get("repeats"))
+
     out = {
-        "metric": "fedavg_resnet18_3client_lbfgs_train_throughput",
+        "metric": (
+            f"fedavg_{model_override}_3client_lbfgs_train_throughput"
+            if model_override
+            else "fedavg_resnet18_3client_lbfgs_train_throughput"
+        ),
         "value": flag["samples_per_sec"],
         "unit": "samples/sec",
         "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
         "batch": batch,
         "n_clients": 3,
         "dtype": dtype,
+        "provenance": stamp,
     }
     if "achieved_tflops" in flag:
         out["achieved_tflops"] = flag["achieved_tflops"]
@@ -988,86 +1010,94 @@ def main() -> None:
             )
     out["roofline"] = roof
 
-    # ---- the probe-batch probe: multi-alpha fan vs sequential search ----
-    try:
-        out["probe_batch"] = _probe_batch_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["probe_batch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # BENCH_PROBES=0 skips the whole subsystem-probe suite (each is a
+    # mini training run): the trend smoke in scripts/ci.sh needs only
+    # the flagship headline, repeated, in seconds not minutes. Skipped
+    # probes leave their keys absent — every headline read below is a
+    # .get() and tolerates that.
+    run_probes = os.environ.get("BENCH_PROBES", "1") != "0"
 
-    # ---- the widened-GEMM probe: --client-fold gemm vs vmap rounds ----
-    try:
-        out["widened"] = _widened_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["widened"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if run_probes:
+        # ---- the probe-batch probe: multi-alpha fan vs sequential search ----
+        try:
+            out["probe_batch"] = _probe_batch_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["probe_batch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the exchange-codec ledger numbers for the flagship group ----
-    try:
-        from federated_pytorch_test_tpu.engine import (
-            Trainer as _Tr,
-            get_preset as _gp,
-        )
-        from federated_pytorch_test_tpu.data import synthetic_cifar as _syn
+        # ---- the widened-GEMM probe: --client-fold gemm vs vmap rounds ----
+        try:
+            out["widened"] = _widened_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["widened"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-        _cfg = _gp("fedavg_resnet", n_clients=3, batch=32,
-                   check_results=False, synthetic_ok=True)
-        _tr = _Tr(_cfg, verbose=False,
-                  source=_syn(n_train=3 * 32, n_test=32))
-        out["exchange"] = _exchange_probe(
-            _tr.partition, _tr.group_order, _tr.group_order[0], 3
-        )
-        _tr.close()
-    except Exception as e:
-        out["exchange"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the exchange-codec ledger numbers for the flagship group ----
+        try:
+            from federated_pytorch_test_tpu.engine import (
+                Trainer as _Tr,
+                get_preset as _gp,
+            )
+            from federated_pytorch_test_tpu.data import synthetic_cifar as _syn
 
-    # ---- the eval-tail probe: folded vs sync check_results rounds ----
-    try:
-        out["eval_tail"] = _eval_tail_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["eval_tail"] = {"error": f"{type(e).__name__}: {e}"[:200]}
-    if compile_cache:
-        out["eval_tail"]["compile_cache"] = os.path.abspath(compile_cache)
+            _cfg = _gp("fedavg_resnet", n_clients=3, batch=32,
+                       check_results=False, synthetic_ok=True)
+            _tr = _Tr(_cfg, verbose=False,
+                      source=_syn(n_train=3 * 32, n_test=32))
+            out["exchange"] = _exchange_probe(
+                _tr.partition, _tr.group_order, _tr.group_order[0], 3
+            )
+            _tr.close()
+        except Exception as e:
+            out["exchange"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the robust-aggregation probe: combiner overhead vs mean ----
-    try:
-        out["robust"] = _robust_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["robust"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the eval-tail probe: folded vs sync check_results rounds ----
+        try:
+            out["eval_tail"] = _eval_tail_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["eval_tail"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        if compile_cache:
+            out["eval_tail"]["compile_cache"] = os.path.abspath(compile_cache)
 
-    # ---- the heterogeneity probe: deadline rounds vs the stall path ----
-    try:
-        out["hetero"] = _hetero_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["hetero"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the robust-aggregation probe: combiner overhead vs mean ----
+        try:
+            out["robust"] = _robust_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["robust"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the fleet probe: auto deadline vs the fixed-deadline sweep ----
-    try:
-        out["fleet"] = _fleet_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["fleet"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the heterogeneity probe: deadline rounds vs the stall path ----
+        try:
+            out["hetero"] = _hetero_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["hetero"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the cohort probe: round wall flat in virtual-population N ----
-    try:
-        out["cohort"] = _cohort_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["cohort"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the fleet probe: auto deadline vs the fixed-deadline sweep ----
+        try:
+            out["fleet"] = _fleet_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["fleet"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the prefetch probe: cohort gather off the round wall ----
-    try:
-        out["prefetch"] = _prefetch_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["prefetch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the cohort probe: round wall flat in virtual-population N ----
+        try:
+            out["cohort"] = _cohort_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["cohort"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the health probe: sketch/monitor overhead per warm round ----
-    try:
-        out["health"] = _health_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["health"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the prefetch probe: cohort gather off the round wall ----
+        try:
+            out["prefetch"] = _prefetch_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["prefetch"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    # ---- the flight probe: recorder overhead + peak host RSS ----
-    try:
-        out["flight"] = _flight_probe()
-    except Exception as e:  # a failed probe must not kill the bench
-        out["flight"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # ---- the health probe: sketch/monitor overhead per warm round ----
+        try:
+            out["health"] = _health_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["health"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+        # ---- the flight probe: recorder overhead + peak host RSS ----
+        try:
+            out["flight"] = _flight_probe()
+        except Exception as e:  # a failed probe must not kill the bench
+            out["flight"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # ---- the utilization sweep: batch and model-size levers ----
     # (round-2 VERDICT: "no row anywhere shows MFU climbing with batch or
@@ -1239,6 +1269,10 @@ def main() -> None:
         "bf16_comm_bytes_per_round": out.get("exchange", {}).get(
             "comm_bytes_per_round"
         ),
+        # the provenance stamp (obs/provenance.py): the headline's
+        # backend/chip/commit identity — what the trend layer's
+        # class-isolated regression sentinel keys on
+        "provenance": stamp,
     }
     # the eval-tail facts (fold/async eval PR): which eval mode the
     # engine defaults to, how many program launches a folded

@@ -280,6 +280,12 @@ def main():
     else:
         out = _k_sweep(jax, jnp, client_fold=args.client_fold)
         path = os.path.join(here, f"client_scaling_tpu{suffix}.json")
+    # the provenance stamp (obs/provenance.py): the trend layer keys
+    # scaling baselines on the stamp's class, and only a satisfying
+    # stamp (backend==tpu) closes the vmapfold DEBT.json entry
+    from federated_pytorch_test_tpu.obs.provenance import provenance_stamp
+
+    out["provenance"] = provenance_stamp()
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {path}")

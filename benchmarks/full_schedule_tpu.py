@@ -280,6 +280,12 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)),
         f"full_{args.preset}{suffix}_tpu.json",
     )
+    # the provenance stamp (obs/provenance.py): this artifact closes a
+    # DEBT.json entry only if the stamp satisfies its condition — a
+    # CPU-twin run of this script can never pay a backend==tpu debt
+    from federated_pytorch_test_tpu.obs.provenance import provenance_stamp
+
+    out["provenance"] = provenance_stamp()
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

@@ -119,6 +119,8 @@ init, so they run on any host):
     python -m federated_pytorch_test_tpu report runs/ --json report.json
     python -m federated_pytorch_test_tpu watch runs/ [--once] [--interval S]
     python -m federated_pytorch_test_tpu scrub ckpt/ [--repair]
+    python -m federated_pytorch_test_tpu trend . benchmarks/ [--store F]
+    python -m federated_pytorch_test_tpu debt [--script remeasure.sh]
 
 `report` ingests a directory of `--metrics-stream` files (validating
 each header like resume does, refusing foreign streams), aligns the
@@ -136,7 +138,12 @@ else drop the chunk so its rows re-initialize pristine. The storage
 fault axis itself rides the plan string — `storage=<p>:<bitrot|torn|
 ioerror|enospc>[:strength]` chaos-injects the store/checkpoint/stream
 byte paths, survived by checksum-verified reads with bounded retry
-(docs/FAULT.md §Storage-integrity axis).
+(docs/FAULT.md §Storage-integrity axis). `trend` (obs/benchdb.py)
+ingests BENCH_*.json wrappers and benchmark artifacts into an
+append-only trend store keyed by (metric, provenance class) and runs
+the noise-aware regression sentinel — CPU-twin baselines never judge
+TPU numbers; `debt` (obs/debt.py) lists DEBT.json's open
+re-measurement entries and emits the runnable script that pays them.
 """
 
 from __future__ import annotations
@@ -354,6 +361,21 @@ def main(argv=None) -> int:
         from federated_pytorch_test_tpu.fault.scrub import scrub_main
 
         return scrub_main(argv[1:])
+    if argv and argv[0] == "trend":
+        # the perf-trend verb (obs/benchdb.py): ingest BENCH wrappers /
+        # benchmark artifacts into the append-only trend store and run
+        # the provenance-class-isolated regression sentinel —
+        # backend-free like report/watch/scrub (pure file analysis)
+        from federated_pytorch_test_tpu.obs.benchdb import trend_main
+
+        return trend_main(argv[1:])
+    if argv and argv[0] == "debt":
+        # the re-measurement debt verb (obs/debt.py): list DEBT.json's
+        # open entries and emit the ready-to-run payment script for the
+        # first session with the owed backend — backend-free too
+        from federated_pytorch_test_tpu.obs.debt import debt_main
+
+        return debt_main(argv[1:])
 
     from federated_pytorch_test_tpu.engine import (
         PRESETS,

@@ -85,6 +85,7 @@ from federated_pytorch_test_tpu.obs import (
     HealthEngine,
     JsonlSink,
     TraceRecorder,
+    cached_stamp,
     incidents_dir,
     memory_record,
     roofline_record,
@@ -2492,6 +2493,10 @@ class Trainer:
             "deadline": self._deadline_for(nloop, gid),
             "incidents": len(self.recorder.series.get("incident", [])),
             "profile_captures": int(self._profile_captures),
+            # who is producing these numbers (obs/provenance.py):
+            # backend/chip/commit, cached so the per-round rewrite
+            # never forks git — `watch` renders it as the prov row
+            "provenance": cached_stamp(),
         }
         if self.store is not None:
             # live store residency for `watch` (and the spill smoke's
@@ -3424,6 +3429,9 @@ class Trainer:
                 hbm_bytes=cost.get("hbm_bytes"),
                 device_kind=jax.devices()[0].device_kind,
                 source=cost.get("source", "measured"),
+                # the stamp that keeps this record from ever serving as
+                # a cross-backend baseline downstream (obs/benchdb.py)
+                provenance=cached_stamp(),
             )
             # the intensity claim as a recorded number, not prose
             # (ISSUE-17): what M the MXU sees through the probe fan.

@@ -1,7 +1,7 @@
 """Observability: sinks, ledger, traces, health, roofline, registry,
-flight recorder, memory telemetry, live console.
+flight recorder, memory telemetry, live console, provenance + trend.
 
-Nine pillars over the structured metric store (`utils/metrics.py`):
+Twelve pillars over the structured metric store (`utils/metrics.py`):
 
 * `JsonlSink` — a crash-safe append-only JSONL metric stream with
   per-outer-loop commit markers; `resume='auto'` replays it and truncates
@@ -36,10 +36,37 @@ Nine pillars over the structured metric store (`utils/metrics.py`):
   bounded-RSS evidence ROADMAP item 4 gates on (memory.py);
 * `watch_main` — the `watch` CLI verb: a refreshing terminal dashboard
   tailing metric streams through the registry's validated ingestion
-  (console.py).
+  (console.py);
+* `provenance_stamp` / `provenance_class` / `condition_satisfied` — the
+  self-describing stamp (commit, backend, chip, host, repeats) attached
+  to every measurement artifact, the isolation key the trend layer
+  compares within, and the DEBT.json condition grammar (provenance.py);
+* `BenchDB` / `trend_main` — the `trend` CLI verb: append-only trend
+  store over BENCH wrappers and benchmark artifacts, keyed by (metric,
+  provenance class), with the noise-aware regression sentinel
+  (benchdb.py);
+* `debt_main` — the `debt` CLI verb: the re-measurement debt ledger as
+  data plus the runnable script that pays it (debt.py).
 """
 
+from federated_pytorch_test_tpu.obs.benchdb import (
+    BenchDB,
+    TrendRefused,
+    extract_measurement,
+    metric_direction,
+    render_trend_markdown,
+    trend_main,
+)
 from federated_pytorch_test_tpu.obs.console import render, watch_main
+from federated_pytorch_test_tpu.obs.debt import (
+    close_entries,
+    debt_main,
+    emit_script,
+    load_debt,
+    open_entries,
+    render_debt_markdown,
+    save_debt,
+)
 from federated_pytorch_test_tpu.obs.flight import (
     MAX_INCIDENTS,
     FlightRecorder,
@@ -61,6 +88,15 @@ from federated_pytorch_test_tpu.obs.memory import (
     host_rss_peak_bytes,
     memory_record,
 )
+from federated_pytorch_test_tpu.obs.provenance import (
+    STAMP_KEYS,
+    cached_stamp,
+    condition_satisfied,
+    git_info,
+    host_stamp,
+    provenance_class,
+    provenance_stamp,
+)
 from federated_pytorch_test_tpu.obs.registry import (
     RunRegistry,
     StreamRefused,
@@ -78,6 +114,7 @@ from federated_pytorch_test_tpu.obs.sinks import JsonlSink
 from federated_pytorch_test_tpu.obs.trace import DispatchCounter, TraceRecorder
 
 __all__ = [
+    "BenchDB",
     "CHIP_PEAKS",
     "CommLedger",
     "DEADLINE_WARMUP_OBS",
@@ -90,21 +127,39 @@ __all__ = [
     "P2Quantile",
     "PercentileSketch",
     "RunRegistry",
+    "STAMP_KEYS",
     "StreamRefused",
     "TraceRecorder",
+    "TrendRefused",
+    "cached_stamp",
     "chip_peaks",
+    "close_entries",
+    "condition_satisfied",
+    "debt_main",
     "device_memory_stats",
+    "emit_script",
+    "extract_measurement",
+    "git_info",
     "host_rss_bytes",
     "host_rss_peak_bytes",
+    "host_stamp",
     "incidents_dir",
     "lbfgs_round_cost",
     "list_incidents",
+    "load_debt",
     "memory_record",
+    "metric_direction",
+    "open_entries",
+    "provenance_class",
+    "provenance_stamp",
     "read_stream",
     "render",
+    "render_debt_markdown",
     "render_markdown",
+    "render_trend_markdown",
     "report_main",
     "roofline_record",
-    "validate_incident",
+    "save_debt",
+    "trend_main",
     "watch_main",
 ]

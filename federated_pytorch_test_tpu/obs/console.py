@@ -276,6 +276,27 @@ def _render_run(name: str, run: RunStream) -> List[str]:
             if roof.get("bound"):
                 parts.append(f"{roof['bound']}-bound")
             lines.append("   roofline " + " | ".join(parts))
+        prov = status.get("provenance")
+        if isinstance(prov, dict):
+            # the provenance row (obs/provenance.py): WHO is producing
+            # these numbers — backend (twin-flagged), commit (dirty
+            # starred), chip — so a live run is attributable at a glance
+            backend = prov.get("backend") or "?"
+            if prov.get("cpu_twin"):
+                backend += " (cpu twin)"
+            parts = [f"backend {backend}"]
+            if prov.get("git_sha"):
+                parts.append(
+                    f"sha {prov['git_sha']}"
+                    + ("*" if prov.get("git_dirty") else "")
+                )
+            if prov.get("device_kind"):
+                parts.append(
+                    f"{prov['device_kind']} x{prov.get('device_count', '?')}"
+                )
+            if prov.get("jax_version"):
+                parts.append(f"jax {prov['jax_version']}")
+            lines.append("   prov  " + " | ".join(parts))
     bundles = list_incidents(run.path)
     if bundles:
         names = []

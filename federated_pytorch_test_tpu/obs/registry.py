@@ -413,19 +413,32 @@ class RunRegistry:
             dig = status.get("integrity")
             if not isinstance(dig, dict):
                 continue
-            rows.append(
-                {
-                    "run": name,
-                    "checksums": dig.get("checksums"),
-                    "alg": dig.get("alg"),
-                    "verified_reads": dig.get("verified_reads"),
-                    "failures": dig.get("failures"),
-                    "retry_heals": dig.get("retry_heals"),
-                    "repairs_prior": dig.get("repairs_prior"),
-                    "repairs_reinit": dig.get("repairs_reinit"),
-                    "storage_faults": status.get("storage_faults"),
+            row = {
+                "run": name,
+                "checksums": dig.get("checksums"),
+                "alg": dig.get("alg"),
+                "verified_reads": dig.get("verified_reads"),
+                "failures": dig.get("failures"),
+                "retry_heals": dig.get("retry_heals"),
+                "repairs_prior": dig.get("repairs_prior"),
+                "repairs_reinit": dig.get("repairs_reinit"),
+                "storage_faults": status.get("storage_faults"),
+            }
+            prov = status.get("provenance")
+            if isinstance(prov, dict):
+                # who produced this run's numbers (obs/provenance.py) —
+                # a process fact like the rest of this table, so it
+                # rides the same behind-the-flag row, never the
+                # deterministic default report
+                from federated_pytorch_test_tpu.obs.provenance import (
+                    provenance_class,
+                )
+
+                row["provenance"] = {
+                    "class": provenance_class(prov),
+                    "git_sha": prov.get("git_sha"),
                 }
-            )
+            rows.append(row)
         return {"count": len(rows), "runs": rows}
 
     def report(self) -> dict:

@@ -163,6 +163,7 @@ def roofline_record(
     peak_hbm_gbps: Optional[float] = None,
     source: str = "measured",
     ndigits: int = 4,
+    provenance: Optional[dict] = None,
 ) -> dict:
     """One roofline record: achieved rates vs the chip's two walls.
 
@@ -170,11 +171,16 @@ def roofline_record(
     measured program (preferred) or `lbfgs_round_cost` (analytic);
     `wall_s` is the measured wall the work actually took. Peaks default
     to `chip_peaks(device_kind)`; on unknown chips the achieved rates
-    are still reported, only the fractions are omitted.
+    are still reported, only the fractions are omitted. `provenance`
+    (an obs/provenance.py stamp) is attached verbatim when given —
+    passed explicitly by callers that already hold one, never probed
+    here (this module stays import-cheap and backend-free).
     """
     if peak_tflops is None and peak_hbm_gbps is None and device_kind:
         peak_tflops, peak_hbm_gbps = chip_peaks(device_kind)
     rec: dict = {"source": source, "wall_s": round(float(wall_s), 4)}
+    if provenance is not None:
+        rec["provenance"] = provenance
     if device_kind:
         rec["device"] = device_kind
     if peak_tflops:
