@@ -121,6 +121,8 @@ init, so they run on any host):
     python -m federated_pytorch_test_tpu scrub ckpt/ [--repair]
     python -m federated_pytorch_test_tpu trend . benchmarks/ [--store F]
     python -m federated_pytorch_test_tpu debt [--script remeasure.sh]
+    python -m federated_pytorch_test_tpu chaos [--budget-s S | --cases N]
+                                               [--seed S] [--repro FILE]
 
 `report` ingests a directory of `--metrics-stream` files (validating
 each header like resume does, refusing foreign streams), aligns the
@@ -144,6 +146,12 @@ append-only trend store keyed by (metric, provenance class) and runs
 the noise-aware regression sentinel — CPU-twin baselines never judge
 TPU numbers; `debt` (obs/debt.py) lists DEBT.json's open
 re-measurement entries and emits the runnable script that pays them.
+`chaos` (fault/chaos.py) soaks the engine under a seeded fuzzer that
+composes random fault-plan axes with random config knobs, checks every
+drawn case against the crash+resume invariant oracle, shrinks any
+violating plan to a 1-minimal repro bundle (exit 2), and replays
+bundles with `--repro FILE` — it forces the host-CPU backend itself,
+so the soak runs on any machine.
 """
 
 from __future__ import annotations
@@ -376,6 +384,15 @@ def main(argv=None) -> int:
         from federated_pytorch_test_tpu.obs.debt import debt_main
 
         return debt_main(argv[1:])
+    if argv and argv[0] == "chaos":
+        # the chaos-harness verb (fault/chaos.py): seeded fuzzer over
+        # composed fault plans x knob lattice, invariant oracle with
+        # crash+resume twins, failing-plan shrinker, repro replay —
+        # dispatched engine-import-free like report/scrub; it pins the
+        # backend to host CPU itself before touching the Trainer
+        from federated_pytorch_test_tpu.fault.chaos import chaos_main
+
+        return chaos_main(argv[1:])
 
     from federated_pytorch_test_tpu.engine import (
         PRESETS,

@@ -302,6 +302,13 @@ class ClientStore:
         self.evictions = 0
         self.spill_bytes = 0
         self.spill_reads = 0
+        # host-side row traffic: surfaced via traffic() in the status
+        # sidecar's store block so the chaos oracle (and `watch`) can
+        # see the cohort data path moving rows
+        self.gather_calls = 0
+        self.gather_rows = 0
+        self.scatter_calls = 0
+        self.scatter_rows = 0
         # storage integrity (module docstring): per-file digests the
         # manifest records, the chaos shim, and the detect/heal/repair
         # counters the `integrity` record + scrub report surface
@@ -441,6 +448,8 @@ class ClientStore:
         its own output."""
         with self._lock:
             ids = self._check_ids(ids)
+            self.gather_calls += 1
+            self.gather_rows += int(ids.size)
             fill = self._fills[name]
             out = np.empty((ids.size,) + fill.shape, fill.dtype)
             for cid, pos, rows in self._by_chunk(ids):
@@ -471,6 +480,8 @@ class ClientStore:
         chunks (RSS stays O(resident + cohort))."""
         with self._lock:
             ids = self._check_ids(ids)
+            self.scatter_calls += 1
+            self.scatter_rows += int(ids.size)
             rows = np.asarray(rows)
             fill = self._fills[name]
             if rows.shape != (ids.size,) + fill.shape:
@@ -1156,6 +1167,20 @@ class ClientStore:
                 "evictions": int(self.evictions),
                 "spill_bytes": int(self.spill_bytes),
                 "spill_reads": int(self.spill_reads),
+            }
+
+    def traffic(self) -> dict:
+        """Cumulative host-side row traffic: how many rows every gather
+        and scatter has moved since construction. Process-local (like
+        the storage-fault counter, a resumed run restarts from zero);
+        the chaos oracle reads it off the status sidecar to assert the
+        cohort data path actually moved rows in cohort mode."""
+        with self._lock:
+            return {
+                "gather_calls": int(self.gather_calls),
+                "gather_rows": int(self.gather_rows),
+                "scatter_calls": int(self.scatter_calls),
+                "scatter_rows": int(self.scatter_rows),
             }
 
     def summary(self) -> dict:

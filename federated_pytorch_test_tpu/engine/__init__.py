@@ -6,6 +6,7 @@ compiled as sharded XLA programs (see `steps.py`).
 """
 
 from federated_pytorch_test_tpu.engine.config import (
+    KNOB_DOMAINS,
     PRESETS,
     ExperimentConfig,
     get_preset,
@@ -14,6 +15,7 @@ from federated_pytorch_test_tpu.engine.trainer import Trainer, run_experiment
 
 __all__ = [
     "ExperimentConfig",
+    "KNOB_DOMAINS",
     "PRESETS",
     "Trainer",
     "get_preset",

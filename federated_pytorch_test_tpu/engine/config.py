@@ -1050,3 +1050,162 @@ def get_preset(name: str, **overrides) -> ExperimentConfig:
         raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
     cfg = PRESETS[name]
     return cfg.replace(**overrides) if overrides else cfg
+
+
+# --------------------------------------------------------- knob domains
+#
+# THE machine-readable knob-domain table (ISSUE 20): one entry per
+# trajectory-relevant ExperimentConfig knob, declaring its valid domain
+# AND one representative out-of-domain value. Two consumers:
+#
+# * the chaos generator (fault/chaos.py ChaosPlanGenerator) draws lattice
+#   values from `choices`/`lo`/`hi`, so a knob's searched range cannot
+#   drift from what `__post_init__` accepts — generator/validator
+#   agreement is a table lookup, not two hand-maintained copies;
+# * the meta-test (tests/test_chaos.py) walks the table injecting each
+#   entry's `bad` value into a valid carrier config (`requires` supplies
+#   the context that makes the knob live, so the injected value's OWN
+#   validation is what fires) and asserts the raised ValueError names
+#   the field — the repo's every-error-names-its-field house rule,
+#   machine-enforced instead of enforced by convention.
+#
+# Entry keys: `kind` ('choice' | 'int' | 'float' | 'flag' | 'str'),
+# `choices` (for 'choice'), `lo`/`hi` (inclusive numeric bounds the
+# generator draws within; None = unbounded on that side), `requires`
+# (field overrides forming the valid carrier context), `bad` (a value
+# whose injection into that context must raise naming the field).
+KNOB_DOMAINS: dict = {
+    "strategy": {
+        "kind": "choice", "choices": ["none", "fedavg", "admm"],
+        "requires": {}, "bad": "gossip",
+    },
+    "compute_dtype": {
+        "kind": "choice", "choices": ["float32", "bfloat16"],
+        "requires": {}, "bad": "float16",
+    },
+    "reg_mode": {
+        "kind": "choice",
+        "choices": ["active_linear", "first_linear", "none"],
+        "requires": {}, "bad": "l1",
+    },
+    "robust_agg": {
+        "kind": "choice", "choices": list(ROBUST_METHODS),
+        "requires": {}, "bad": "krum",
+    },
+    "robust_f": {
+        # trimmed additionally needs n_clients > 2*robust_f — the
+        # generator sizes f against its drawn client axis
+        "kind": "int", "lo": 0, "hi": None,
+        "requires": {}, "bad": -1,
+    },
+    "quarantine_z": {
+        "kind": "float", "lo": 0.0, "hi": None,
+        "requires": {}, "bad": -0.5,
+    },
+    "exchange_dtype": {
+        "kind": "choice", "choices": list(EXCHANGE_DTYPES),
+        "requires": {}, "bad": "float16",
+    },
+    "exchange_codec": {
+        "kind": "choice", "choices": [None] + list(EXCHANGE_CODECS),
+        "requires": {}, "bad": "gzip",
+    },
+    "topk_fraction": {
+        "kind": "float", "lo": 0.05, "hi": 1.0,
+        "requires": {"exchange_codec": "topk"}, "bad": 1.5,
+    },
+    "quant_bits": {
+        "kind": "choice", "choices": [4, 8],
+        "requires": {"exchange_codec": "quant"}, "bad": 5,
+    },
+    "error_feedback": {
+        # valid only beside a LOSSY codec; `bad` injects it on the
+        # identity wire, whose error must name the knob
+        "kind": "flag", "requires": {}, "bad": True,
+    },
+    "group_schedule": {
+        "kind": "choice", "choices": list(GROUP_SCHEDULES),
+        "requires": {}, "bad": "random",
+    },
+    "group_skip_frac": {
+        "kind": "float", "lo": 0.0, "hi": 0.99,
+        "requires": {"group_schedule": "adaptive"}, "bad": 1.5,
+    },
+    "round_deadline": {
+        # float seconds or the 'auto[:pXX]' policy; the generator draws
+        # from `choices` when set (a continuous deadline is derived from
+        # the plan's step_time axis, not from this table)
+        "kind": "choice", "choices": [None, "auto", "auto:p75"],
+        "requires": {}, "bad": "auto:p0",
+    },
+    "virtual_clients": {
+        "kind": "int", "lo": 1, "hi": None,
+        "requires": {"cohort": None}, "bad": 0,
+    },
+    "cohort": {
+        "kind": "int", "lo": 1, "hi": None,
+        "requires": {"virtual_clients": 6}, "bad": 9,
+    },
+    "cohort_seed": {
+        # any int is in-domain; the invalid use is setting it WITHOUT a
+        # virtual population, and that error must still name the knob
+        "kind": "int", "lo": 0, "hi": None,
+        "requires": {}, "bad": 1,
+    },
+    "cohort_weighting": {
+        "kind": "choice",
+        "choices": ["uniform", "samples", "identity", "telemetry"],
+        "requires": {"virtual_clients": 6, "cohort": 3}, "bad": "speed",
+    },
+    "data_shards": {
+        "kind": "int", "lo": 1, "hi": None,
+        "requires": {"virtual_clients": 6, "cohort": 3}, "bad": 9,
+    },
+    "store_chunk_clients": {
+        "kind": "int", "lo": 1, "hi": None,
+        "requires": {"virtual_clients": 6, "cohort": 3}, "bad": 0,
+    },
+    "store_resident_chunks": {
+        "kind": "int", "lo": 1, "hi": None,
+        "requires": {"virtual_clients": 6, "cohort": 3}, "bad": 0,
+    },
+    "prefetch": {
+        # in-domain over a virtual population; `bad` disables it in
+        # legacy mode, whose error must name the knob
+        "kind": "flag", "requires": {}, "bad": False,
+    },
+    "client_fold": {
+        "kind": "choice", "choices": ["gemm", "vmap"],
+        "requires": {}, "bad": "loop",
+    },
+    "linesearch_probes": {
+        "kind": "int", "lo": 1, "hi": 4,
+        "requires": {}, "bad": 0,
+    },
+    "fault_mode": {
+        "kind": "choice", "choices": ["warn", "raise", "rollback", "off"],
+        "requires": {}, "bad": "panic",
+    },
+    "resume": {
+        "kind": "choice", "choices": ["off", "auto"],
+        "requires": {}, "bad": "always",
+    },
+    "health_window": {
+        "kind": "int", "lo": 1, "hi": None, "requires": {}, "bad": 0,
+    },
+    "flight_window": {
+        "kind": "int", "lo": 1, "hi": None, "requires": {}, "bad": 0,
+    },
+    "profile_budget": {
+        "kind": "int", "lo": 1, "hi": None, "requires": {}, "bad": 0,
+    },
+    "max_groups": {
+        "kind": "int", "lo": 1, "hi": None, "requires": {}, "bad": 0,
+    },
+    "max_scan_steps": {
+        "kind": "int", "lo": 1, "hi": None, "requires": {}, "bad": 0,
+    },
+    "diagnostics_every": {
+        "kind": "int", "lo": 1, "hi": None, "requires": {}, "bad": 0,
+    },
+}

@@ -2502,6 +2502,7 @@ class Trainer:
             # live store residency for `watch` (and the spill smoke's
             # RSS-ceiling read rides the sidecar's memory block)
             doc["store"] = self.store.residency()
+            doc["store"]["traffic"] = self.store.traffic()
             # live integrity digest (verified reads / failures / repair
             # ladder counts) — process facts like residency, surfaced
             # here and via `report --integrity`, never in the stream
@@ -3289,6 +3290,7 @@ class Trainer:
                 # finished run's `watch` panel should show where the
                 # store actually ended up
                 doc["store"] = self.store.residency()
+                doc["store"]["traffic"] = self.store.traffic()
                 doc["integrity"] = self.store.integrity_digest()
             if self._storage_shim is not None:
                 doc["storage_faults"] = int(self._storage_shim.injected)

@@ -16,11 +16,29 @@ The subsystem has three parts, stitched into the engine by `Trainer`:
 * the STORAGE axis (io.py) — checksums, the fault-pluggable I/O shim
   the ClientStore/checkpoint/stream byte paths route through, and the
   bounded disk retry; scrub.py is the engine-import-free `scrub` CLI
-  verb that walks a store/checkpoint dir verifying and repairing.
+  verb that walks a store/checkpoint dir verifying and repairing;
+* the CHAOS HARNESS (chaos.py) — the `chaos` CLI verb: a seeded fuzzer
+  composing fault-plan axes (PLAN_DOMAINS) with engine knobs
+  (engine.KNOB_DOMAINS), the crash+resume invariant oracle, and the
+  delta-debugging shrinker that minimizes violating plans into
+  self-contained repro bundles.
 
 See docs/FAULT.md for the replay/resume guarantees.
 """
 
+from federated_pytorch_test_tpu.fault.chaos import (
+    AXES,
+    INVARIANTS,
+    KNOB_GROUPS,
+    PLAN_DOMAINS,
+    ChaosCase,
+    ChaosPlanGenerator,
+    load_repro_bundle,
+    norm_stream_records,
+    run_case,
+    shrink,
+    write_repro_bundle,
+)
 from federated_pytorch_test_tpu.fault.injector import (
     FaultInjector,
     step_budgets,
@@ -47,10 +65,16 @@ from federated_pytorch_test_tpu.fault.plan import (
 )
 
 __all__ = [
+    "AXES",
     "CHECKSUM_ALG",
     "CORRUPT_MODES",
+    "INVARIANTS",
+    "KNOB_GROUPS",
+    "PLAN_DOMAINS",
     "SEED_FOLDS",
     "STORAGE_MODES",
+    "ChaosCase",
+    "ChaosPlanGenerator",
     "CrashPoint",
     "FaultInjector",
     "FaultPlan",
@@ -59,10 +83,15 @@ __all__ = [
     "StorageFaultShim",
     "checksum",
     "fold_seed",
+    "load_repro_bundle",
+    "norm_stream_records",
     "retry_io",
+    "run_case",
+    "shrink",
     "stamp_crc",
     "step_budgets",
     "storage_shim_for",
     "verify_crc",
     "verify_digest",
+    "write_repro_bundle",
 ]

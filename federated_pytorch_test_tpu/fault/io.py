@@ -38,9 +38,14 @@ parts:
 
 * **Retry.** `retry_io` is the PR-1 multihost retry shape
   (parallel/multihost.py initialize_distributed) for disk I/O: bounded
-  attempts, `backoff_s * 2**attempt` sleeps capped at 30 s, a warning
-  per failed attempt, and the LAST error re-raised loudly when every
-  attempt fails.
+  attempts, `backoff_s * 2**attempt` sleeps capped at 30 s and scaled by
+  a DETERMINISTIC seeded jitter in [0.5, 1.5) — pure in (`what`,
+  attempt), so concurrent prefetch/scatter retries under an ioerror
+  storm desynchronize instead of stampeding the disk in lockstep while
+  any single caller's schedule stays exactly reproducible
+  (`retry_schedule` is the pinned contract) — a warning per failed
+  attempt, and the LAST error re-raised loudly when every attempt
+  fails.
 
 `IntegrityError` is the loud refusal: raised when a checksum mismatch
 survives the retry and the caller has no repair left, always naming the
@@ -164,6 +169,42 @@ def verify_crc(d: dict) -> bool:
 # -------------------------------------------------------------------- retry
 
 
+def retry_delay(
+    what: str, attempt: int, backoff_s: float = 0.05, cap_s: float = 30.0
+) -> float:
+    """The seconds `retry_io` sleeps after failed attempt `attempt`
+    (0-based): the capped exponential base `min(backoff_s * 2**attempt,
+    cap_s)` scaled by a seeded jitter factor in [0.5, 1.5).
+
+    The jitter is DETERMINISTIC — pure in (`what`, attempt), seeded by
+    crc32 of the `what` label — so any single caller's retry schedule
+    is exactly reproducible (and unit-pinnable), while DIFFERENT
+    callers (the cohort prefetcher's chunk reads, the scatter path's
+    chunk writes, the stream sink — each names itself differently)
+    desynchronize under a shared ioerror storm instead of re-hitting
+    the disk in lockstep at every power-of-two boundary.
+    """
+    base = min(backoff_s * (2.0**attempt), cap_s)
+    rng = np.random.default_rng(
+        [zlib.crc32(what.encode()) & 0x7FFFFFFF, attempt]
+    )
+    return base * (0.5 + rng.random())
+
+
+def retry_schedule(
+    what: str,
+    attempts: int = 3,
+    backoff_s: float = 0.05,
+    cap_s: float = 30.0,
+) -> list:
+    """The full sleep schedule one `retry_io(what=...)` call would serve
+    if every attempt failed — `attempts - 1` delays (no sleep follows
+    the last attempt). Pure in its arguments; tests pin it."""
+    return [
+        retry_delay(what, a, backoff_s, cap_s) for a in range(attempts - 1)
+    ]
+
+
 def retry_io(
     fn: Callable,
     *,
@@ -172,12 +213,14 @@ def retry_io(
     backoff_s: float = 0.05,
     retry_on: Tuple[type, ...] = (OSError,),
 ):
-    """Run `fn()` with bounded retry + exponential backoff (the PR-1
-    multihost retry shape): `attempts` tries, `backoff_s * 2**attempt`
-    seconds between them (capped at 30 s), a warning per failed attempt,
-    and the LAST error re-raised when every attempt fails — transient
-    injected `ioerror`/`enospc` (and real flaky disks) are absorbed with
-    zero trajectory change, persistent failures stay loud."""
+    """Run `fn()` with bounded retry + jittered exponential backoff (the
+    PR-1 multihost retry shape): `attempts` tries, `retry_delay(what,
+    attempt)` seconds between them — `backoff_s * 2**attempt` capped at
+    30 s, scaled by the deterministic seeded jitter — a warning per
+    failed attempt, and the LAST error re-raised when every attempt
+    fails — transient injected `ioerror`/`enospc` (and real flaky
+    disks) are absorbed with zero trajectory change, persistent
+    failures stay loud."""
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
     last: Optional[BaseException] = None
@@ -187,7 +230,7 @@ def retry_io(
         except retry_on as e:
             last = e
             if attempt + 1 < attempts:
-                delay = min(backoff_s * (2.0**attempt), 30.0)
+                delay = retry_delay(what, attempt, backoff_s)
                 warnings.warn(
                     f"{what} failed (attempt {attempt + 1}/{attempts}): "
                     f"{e}; retrying in {delay:.2f}s"

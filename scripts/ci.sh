@@ -33,7 +33,7 @@
 #                                    quarantine composition, crash/
 #                                    resume stream identity with
 #                                    deadline records), plus the CLI
-#                                    smokes below: chaos_smoke
+#                                    smokes below: byzantine_smoke
 #                                    (corruption plan + trimmed combiner
 #                                    + quarantine + planned crash,
 #                                    recovered end to end with --resume
@@ -123,6 +123,17 @@
 #                                    and the CPU-twin runs leaving every
 #                                    backend==tpu DEBT.json entry open —
 #                                    the class-isolation rule end to end)
+#                                    and chaos_smoke (the chaos HARNESS
+#                                    — fault/chaos.py: a fixed-seed
+#                                    soak of composed fuzzer-drawn
+#                                    plans must clear the invariant
+#                                    oracle clean, then a deliberately
+#                                    broken robust combiner
+#                                    (CHAOS_PLANT_BUG=combiner) must be
+#                                    CAUGHT, SHRUNK to a <=2-axis repro
+#                                    bundle, and REPLAYED from the
+#                                    bundle via chaos --repro — the
+#                                    oracle's own false-negative test)
 #
 # Every tier starts with a PREFLIGHT stray-process check (see
 # preflight() below): the tier-1 wall sits within ~10 s of the driver's
@@ -275,7 +286,7 @@ if sys.argv[3]:
 PY
 }
 
-chaos_smoke() {
+byzantine_smoke() {
   # End-to-end Byzantine chaos through the REAL CLI: one client per round
   # sends a 10x-scaled update, trimmed-mean(1) + auto-quarantine defend,
   # and a planned crash at (nloop=1, gid=2, nadmm=0) kills the first run
@@ -291,25 +302,121 @@ chaos_smoke() {
     --robust-agg trimmed --robust-f 1 --quarantine-z 1.0
     --fault-mode rollback --save-model --resume auto
     --checkpoint-dir "$d/ckpt" --metrics-stream "$d/run.jsonl")
-  echo "chaos smoke: expecting the planned crash..."
+  echo "byzantine smoke: expecting the planned crash..."
   if "${cmd[@]}" > "$d/run1.log" 2>&1; then
-    echo "chaos smoke FAILED: the planned crash never fired" >&2
+    echo "byzantine smoke FAILED: the planned crash never fired" >&2
     tail -5 "$d/run1.log" >&2; rm -rf "$d"; return 1
   fi
-  echo "chaos smoke: resuming..."
+  echo "byzantine smoke: resuming..."
   "${cmd[@]}" > "$d/run2.log" 2>&1 || {
-    echo "chaos smoke FAILED: resume did not finish" >&2
+    echo "byzantine smoke FAILED: resume did not finish" >&2
     tail -20 "$d/run2.log" >&2; rm -rf "$d"; return 1
   }
   # 2 nloops x 1 group x 2 exchanges, one corrupted client each = 4
   grep -q '# faults injected: .*corruptions=4' "$d/run2.log" || {
-    echo "chaos smoke FAILED: missing/incorrect injected-faults line" >&2
+    echo "byzantine smoke FAILED: missing/incorrect injected-faults line" >&2
     grep '# faults' "$d/run2.log" >&2; rm -rf "$d"; return 1
   }
   if grep -q 'round_rollback' "$d/run.jsonl"; then
-    echo "chaos smoke FAILED: the robust combiner let a round roll back" >&2
+    echo "byzantine smoke FAILED: the robust combiner let a round roll back" >&2
     rm -rf "$d"; return 1
   fi
+  echo "byzantine smoke OK"
+  rm -rf "$d"
+}
+
+chaos_smoke() {
+  # The chaos HARNESS end to end (fault/chaos.py, ISSUE 20): two legs.
+  #
+  # Leg 1 — fixed-seed soak: the first handful of fuzzer-drawn composed
+  # plans (the three deterministic invariant probes + composed cases)
+  # must clear the full invariant oracle with ZERO violations. Every
+  # verdict streams to verdicts.jsonl; the chaos_soak.json workload
+  # summary is crc-self-verified and fed to the trend store by
+  # trend_feed (it carries a host provenance stamp).
+  #
+  # Leg 2 — the planted bug: CHAOS_PLANT_BUG=combiner swaps the
+  # Byzantine-robust combiner for a naive masked mean that averages
+  # NaNs straight in. The harness must CATCH the robust_finite
+  # violation (exit 2), SHRINK it to a repro bundle of <= 2 fault axes,
+  # REPLAY the bundle to the same violation under the planted bug
+  # (chaos --repro, exit 0), and see it NOT reproduce on the honest
+  # engine (exit 1) — the oracle's own false-negative test.
+  local d t0; d="$(mktemp -d)"; t0=$SECONDS
+  echo "chaos smoke: soaking fixed-seed composed plans under the oracle..."
+  if ! python -m federated_pytorch_test_tpu chaos \
+      --cases 5 --seed 0 --budget-s 900 --out "$d/soak" \
+      > "$d/soak.log" 2>&1; then
+    echo "chaos smoke FAILED: clean-engine soak found a violation" >&2
+    tail -30 "$d/soak.log" >&2; rm -rf "$d"; return 1
+  fi
+  python - "$d/soak" <<'PY' || { rm -rf "$d"; return 1; }
+import json, sys
+
+from federated_pytorch_test_tpu.fault.io import verify_crc
+
+out = sys.argv[1]
+doc = json.load(open(f"{out}/chaos_soak.json"))
+assert verify_crc(doc), "soak summary failed its own crc"
+assert doc["workload"] == "chaos_soak" and doc["violations"] == 0, doc
+verdicts = [json.loads(l) for l in open(f"{out}/verdicts.jsonl")]
+assert len(verdicts) == doc["cases_cleared"] >= 5
+assert all(v["ok"] for v in verdicts)
+assert verdicts[0]["provenance"]["backend"] == "cpu"
+cov = verdicts[-1]["coverage"]
+print(f"chaos smoke: {len(verdicts)} plans clean, axes={sorted(cov['axes'])}")
+PY
+  echo "chaos smoke: planting a broken combiner..."
+  set +e
+  CHAOS_PLANT_BUG=combiner python -m federated_pytorch_test_tpu chaos \
+    --cases 3 --seed 0 --out "$d/plant" > "$d/plant.log" 2>&1
+  local rc=$?
+  set -e
+  if [ "$rc" -ne 2 ]; then
+    echo "chaos smoke FAILED: planted combiner bug not caught (rc=$rc)" >&2
+    tail -30 "$d/plant.log" >&2; rm -rf "$d"; return 1
+  fi
+  local bundle="$d/plant/repro-0000.json"
+  python - "$bundle" <<'PY' || { rm -rf "$d"; return 1; }
+import json, sys
+
+doc = json.load(open(sys.argv[1]))
+axes = doc["case"]["axes"]
+assert len(axes) <= 2, f"shrunk repro kept {len(axes)} axes: {axes}"
+bad = {v["invariant"] for v in doc["violations"]}
+assert "robust_finite" in bad, bad
+print(f"chaos smoke: shrunk to axes={axes}, violations={sorted(bad)}")
+PY
+  echo "chaos smoke: replaying the shrunk bundle..."
+  CHAOS_PLANT_BUG=combiner python -m federated_pytorch_test_tpu chaos \
+    --repro "$bundle" --out "$d/replay" > "$d/replay.log" 2>&1 || {
+    echo "chaos smoke FAILED: bundle did not reproduce under the bug" >&2
+    tail -10 "$d/replay.log" >&2; rm -rf "$d"; return 1
+  }
+  if python -m federated_pytorch_test_tpu chaos \
+      --repro "$bundle" --out "$d/replay2" > "$d/replay2.log" 2>&1; then
+    echo "chaos smoke FAILED: bundle 'reproduced' on the honest engine" >&2
+    tail -10 "$d/replay2.log" >&2; rm -rf "$d"; return 1
+  fi
+  # feed this smoke's wall to the preflight JSON like run_tier does, so
+  # the chaos soak's cost is a trend-store trajectory too
+  python - chaos_smoke "$((SECONDS - t0))" \
+    "${CI_PREFLIGHT_JSON:-ci_preflight.json}" <<'PY' || true
+import json, sys
+
+label, wall, out = sys.argv[1:4]
+try:
+    with open(out) as f:
+        doc = json.load(f)
+except Exception:
+    doc = {}
+doc.setdefault("tiers", []).append(
+    {"tier": label, "wall_s": int(wall), "passed": 2, "rc": 0}
+)
+with open(out, "w") as f:
+    json.dump(doc, f, indent=1)
+    f.write("\n")
+PY
   echo "chaos smoke OK"
   rm -rf "$d"
 }
@@ -1314,7 +1421,7 @@ case "$tier" in
   1) run_tier tier1 tests/ -m 'not slow' -q "$@" ;;
   2)
     run_tier slow tests/ -m slow -q "$@"
-    chaos_smoke
+    byzantine_smoke
     hetero_smoke
     bf16_smoke
     codec_smoke
@@ -1326,11 +1433,12 @@ case "$tier" in
     integrity_smoke
     widened_smoke
     trend_smoke
+    chaos_smoke
     ;;
   all)
     run_tier tier1 tests/ -m 'not slow' -q "$@"
     run_tier slow tests/ -m slow -q "$@"
-    chaos_smoke
+    byzantine_smoke
     hetero_smoke
     bf16_smoke
     codec_smoke
@@ -1342,6 +1450,7 @@ case "$tier" in
     integrity_smoke
     widened_smoke
     trend_smoke
+    chaos_smoke
     ;;
   *) echo "unknown CI_TIER='$tier' (want 0, 1, 2 or all)" >&2; exit 2 ;;
 esac

@@ -47,32 +47,15 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 @pytest.fixture(scope="session")
 def norm_stream():
-    """THE twin-stream normalizer (the pytest face of scripts/ci.sh
-    `assert_stream_identity`): parse a JSONL metric stream into records
-    equal modulo wall-clock fields — the `t` stamp, `step_time` seconds
-    — and the header tag (crashed+resumed twins' plans legitimately
-    differ by the fired crash point). Every crash+resume identity test
-    must normalize through this one definition: a wall-clock field added
-    to the stream format is then ignored (or surfaced) everywhere at
-    once instead of by three drifting copies."""
-    import json
+    """THE twin-stream normalizer, now defined once in
+    fault/chaos.py (`norm_stream_records` — the chaos oracle's
+    stream-identity invariant runs through the same code path as every
+    crash+resume identity test and ci.sh `assert_stream_identity`): a
+    wall-clock field added to the stream format is ignored (or
+    surfaced) everywhere at once instead of by three drifting copies."""
+    from federated_pytorch_test_tpu.fault.chaos import norm_stream_records
 
-    def norm(path):
-        out = []
-        for line in open(path):
-            d = json.loads(line)
-            d.pop("t", None)
-            d.pop("crc", None)  # per-line checksums differ with content
-            if d.get("event") == "stream_header":
-                d.pop("tag", None)
-            if d.get("series") == "step_time":
-                d["value"] = {
-                    k: v for k, v in d["value"].items() if k != "seconds"
-                }
-            out.append(d)
-        return out
-
-    return norm
+    return norm_stream_records
 
 
 @pytest.fixture(scope="session")

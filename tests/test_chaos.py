@@ -1,0 +1,358 @@
+"""Chaos-harness unit tests (fault/chaos.py): generator validity and
+determinism, the FaultPlan serialization round-trip property over
+generator draws, the KNOB_DOMAINS error-message meta-test, shrinker
+1-minimality, and the repro-bundle format.
+
+Everything here is PURE HOST — the invariant oracle's actual Trainer
+runs live in the tier-2 `chaos_smoke` CI leg (scripts/ci.sh), which
+also plants a broken combiner and asserts the harness catches, shrinks,
+and replays the violation. These tests pin the machinery that leg
+depends on, at tier-1 cost.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from federated_pytorch_test_tpu.engine.config import KNOB_DOMAINS, get_preset
+from federated_pytorch_test_tpu.fault import (
+    AXES,
+    KNOB_GROUPS,
+    PLAN_DOMAINS,
+    ChaosCase,
+    ChaosPlanGenerator,
+    CrashPoint,
+    FaultPlan,
+    load_repro_bundle,
+    norm_stream_records,
+    shrink,
+    write_repro_bundle,
+)
+from federated_pytorch_test_tpu.fault.chaos import AXIS_FIELDS, components
+
+smoke = pytest.mark.smoke
+
+N_DRAWS = 60  # property-test sample size: covers several full rotations
+
+
+def _valid_config(case: ChaosCase):
+    """Build the exact config the oracle would run (fault/chaos.py
+    `_build_cfg` semantics minus the run-dir paths)."""
+    over = case.config_overrides()
+    over.update(
+        model="net", batch=40, check_results=False, synthetic_ok=True,
+        shuffle_group_order=False, resume="auto",
+    )
+    over.setdefault("max_groups", 1)
+    return get_preset("fedavg", **over)
+
+
+# ------------------------------------------------------------- generator
+
+
+@smoke
+def test_plan_domains_name_real_fields():
+    plan_fields = {f.name for f in dataclasses.fields(FaultPlan)}
+    for axis, spec in PLAN_DOMAINS.items():
+        assert axis in AXES
+        for field in spec:
+            assert field in plan_fields, f"{axis} draws unknown {field}"
+    # the shrinker's reset map covers every drawn field (plus the
+    # structural extras: crashes, the p-knob of k-targeted axes)
+    for axis in AXES:
+        for field in AXIS_FIELDS[axis]:
+            assert field in plan_fields
+
+
+@smoke
+def test_generator_is_pure_in_seed_and_index():
+    gen_a, gen_b = ChaosPlanGenerator(seed=7), ChaosPlanGenerator(seed=7)
+    for i in range(N_DRAWS):
+        assert gen_a.draw(i) == gen_b.draw(i)
+    # a different generator seed perturbs the composed cases (the
+    # deterministic probes 0-2 are seed-independent by design)
+    other = ChaosPlanGenerator(seed=8)
+    assert any(other.draw(i) != gen_a.draw(i) for i in range(3, N_DRAWS))
+
+
+@smoke
+def test_generator_draws_valid_configs_by_construction():
+    """The tentpole's core claim: every draw passes the strict config
+    validators — the fuzzer explores INSIDE the domain table, so a
+    violation found by a soak is an engine bug, never a bad draw."""
+    gen = ChaosPlanGenerator(seed=0)
+    for i in range(N_DRAWS):
+        case = gen.draw(i)
+        cfg = _valid_config(case)  # raises ValueError on any bad draw
+        assert cfg.nloop == case.base["nloop"]
+        # validity couplings hold structurally too
+        if "churn" in case.axes:
+            assert "cohort" in case.knobs
+        if "deadline" in case.knobs:
+            assert "speed" in case.axes
+        if case.plan.corrupt_mode == "nan_burst" and "corruption" in case.axes:
+            assert "robust" in case.knobs
+            assert "quarantine" not in case.knobs
+
+
+@smoke
+def test_generator_coverage_rotation():
+    """Axis i%7 and knob group i%8 are forced into case i: every axis
+    and every lattice knob group appears within the first rotation of
+    composed cases — a 50-case soak cannot miss one."""
+    gen = ChaosPlanGenerator(seed=0)
+    axes, groups = set(), set()
+    for i in range(3, 3 + max(len(AXES), len(KNOB_GROUPS)) * 2):
+        case = gen.draw(i)
+        axes |= set(case.axes)
+        groups |= set(case.knobs)
+    assert axes == set(AXES)
+    assert groups == set(KNOB_GROUPS)
+
+
+@smoke
+def test_plan_roundtrip_property_over_generator_draws():
+    """FaultPlan serialization round-trip as a property test over the
+    fuzzer's own distribution: every drawn plan survives
+    to_json -> from_json exactly (the strict loader — unknown keys and
+    drifted crash schemas are rejected, not coerced)."""
+    gen = ChaosPlanGenerator(seed=3)
+    for i in range(N_DRAWS):
+        plan = gen.draw(i).plan
+        assert FaultPlan.from_json(plan.to_json()) == plan
+    # strictness rider: a round-tripped doc with one foreign key fails
+    doc = json.loads(gen.draw(5).plan.to_json())
+    doc["droput_p"] = 0.5  # the typo from_json exists to catch
+    with pytest.raises(ValueError, match="droput_p"):
+        FaultPlan.from_json(json.dumps(doc))
+
+
+@smoke
+def test_case_doc_roundtrip():
+    gen = ChaosPlanGenerator(seed=1)
+    for i in range(0, N_DRAWS, 7):
+        case = gen.draw(i)
+        again = ChaosCase.from_doc(json.loads(json.dumps(case.to_doc())))
+        assert again == case
+
+
+# ---------------------------------------------------- knob-domain table
+
+
+@smoke
+def test_knob_domains_bad_values_name_the_field():
+    """The exported knob-domain meta-test (ISSUE 20 satellite): walk
+    engine.KNOB_DOMAINS, inject each entry's out-of-range `bad` value
+    into the context its `requires` supplies, and assert the validator
+    rejects it with an error NAMING the offending field — the contract
+    that makes a fuzzer violation message actionable."""
+    for field, spec in KNOB_DOMAINS.items():
+        overrides = {**spec["requires"], field: spec["bad"]}
+        with pytest.raises(ValueError, match=field):
+            get_preset("fedavg", **overrides)
+
+
+@smoke
+def test_knob_domains_table_shape():
+    for field, spec in KNOB_DOMAINS.items():
+        assert spec["kind"] in ("choice", "int", "float", "flag"), field
+        assert "bad" in spec and "requires" in spec, field
+        if spec["kind"] == "choice":
+            assert spec["bad"] not in spec["choices"], field
+
+
+# -------------------------------------------------------------- shrinker
+
+
+def _composed_case() -> ChaosCase:
+    """A deliberately over-wide case for shrinker tests."""
+    return ChaosCase(
+        index=99, gen_seed=0,
+        axes=("dropout", "straggler", "crash", "corruption", "speed"),
+        plan=FaultPlan(
+            seed=9, dropout_p=0.3, straggler_p=0.5, straggler_delay_s=0.002,
+            corrupt_k=1, corrupt_mode="scale", corrupt_strength=4.0,
+            slow_k=1, slow_factor=2.0, step_time_s=0.001,
+            crashes=(CrashPoint(1, 2, 0),),
+        ),
+        knobs={
+            "robust": {"robust_agg": "median", "robust_f": 1},
+            "probes": {"linesearch_probes": 2},
+        },
+        base={"n_clients": 5, "strategy": "fedavg", "nloop": 2, "nadmm": 2},
+    )
+
+
+@smoke
+def test_shrink_reaches_one_minimal_fixpoint():
+    """Greedy delta-debugging on a stub oracle: the violation holds iff
+    the corruption axis AND the robust knob survive. The shrunk case
+    must keep exactly those and be 1-minimal — every remaining
+    component's removal kills the (stub) violation."""
+    test_fn = lambda c: "corruption" in c.axes and "robust" in c.knobs
+    shrunk = shrink(_composed_case(), test_fn)
+    assert test_fn(shrunk)
+    assert "corruption" in shrunk.axes
+    assert set(shrunk.knobs) == {"robust"}
+    assert not shrunk.plan.crashes
+    assert shrunk.base["nloop"] == 1
+    assert shrunk.base["n_clients"] == 3
+    # axes reduced to the load-bearing one (+ nothing else)
+    assert shrunk.axes == ("corruption",)
+    # 1-minimality, verified literally: no single further reduction
+    # still violates
+    for name, reduced in components(shrunk):
+        assert not test_fn(reduced), f"{name} was removable"
+    # removed axes' plan fields are back at dataclass defaults, so the
+    # shrunk plan serializes small and honest
+    assert shrunk.plan.dropout_p == 0.0
+    assert shrunk.plan.straggler_p == 0.0
+    assert shrunk.plan.slow_k == 0
+
+
+@smoke
+def test_shrink_keeps_everything_when_all_load_bearing():
+    case = _composed_case()
+    everything = (set(case.axes), set(case.knobs), case.base["nloop"])
+    test_fn = lambda c: (
+        (set(c.axes), set(c.knobs), c.base["nloop"]) == everything
+        and bool(c.plan.crashes) and c.base["n_clients"] == 5
+    )
+    assert shrink(case, test_fn) == case
+
+
+@smoke
+def test_shrink_preserves_validity_couplings():
+    """Reductions that would turn an engine-bug repro into a
+    self-inflicted invalid config are never offered: the cohort group
+    is pinned under churn, the robust defense under nan_burst, and
+    removing the speed axis takes the deadline knob with it."""
+    churn_case = ChaosCase(
+        index=1, gen_seed=0, axes=("crash", "speed", "churn"),
+        plan=FaultPlan(
+            seed=1, churn_p=0.2, slow_k=1, slow_factor=2.0,
+            step_time_s=0.001, crashes=(CrashPoint(1, 2, 0),),
+        ),
+        knobs={
+            "cohort": {"virtual_clients": 8, "cohort": 4,
+                       "cohort_weighting": "uniform"},
+            "deadline": {"round_deadline": "auto"},
+        },
+        base={"n_clients": 3, "strategy": "fedavg", "nloop": 2, "nadmm": 2},
+    )
+    offered = dict(components(churn_case))
+    assert "knob:cohort" not in offered  # churn needs the sampler pool
+    assert "clients:3" not in offered  # n_clients is dead in cohort mode
+    # dropping the speed axis drops the deadline knob with it
+    assert "deadline" not in offered["axis:speed"].knobs
+
+    nan_case = ChaosCase(
+        index=2, gen_seed=0, axes=("corruption", "crash"),
+        plan=FaultPlan(
+            seed=2, corrupt_k=1, corrupt_mode="nan_burst",
+            crashes=(CrashPoint(1, 2, 0),),
+        ),
+        knobs={"robust": {"robust_agg": "median", "robust_f": 1}},
+        base={"n_clients": 5, "strategy": "fedavg", "nloop": 2, "nadmm": 2},
+        tags=("robust_finite",),
+    )
+    offered = dict(components(nan_case))
+    assert "knob:robust" not in offered  # undefended nan_burst is unfair
+    # ...but the corruption axis itself may go (taking the tag along)
+    assert "robust_finite" not in offered["axis:corruption"].tags
+
+
+# ---------------------------------------------------------- repro bundle
+
+
+@smoke
+def test_repro_bundle_roundtrip_and_tamper_detection(tmp_path):
+    case = _composed_case()
+    verdict = {
+        "violations": [{"invariant": "robust_finite", "detail": "stub"}],
+        "crashes_fired": 1,
+    }
+    path = str(tmp_path / "repro.json")
+    doc = write_repro_bundle(path, case, verdict, str(tmp_path))
+    assert doc["chaos_repro"] == 1
+    loaded_case, loaded_doc = load_repro_bundle(path)
+    assert loaded_case == case
+    assert loaded_doc["violations"] == verdict["violations"]
+    # a hand-edited bundle fails its crc instead of being trusted
+    tampered = json.load(open(path))
+    tampered["case"]["base"]["nloop"] = 5
+    with open(path, "w") as f:
+        json.dump(tampered, f)
+    with pytest.raises(ValueError, match="crc"):
+        load_repro_bundle(path)
+    # a non-bundle is refused by format version, before crc
+    with open(path, "w") as f:
+        json.dump({"workload": "chaos_soak"}, f)
+    with pytest.raises(ValueError, match="not a chaos repro"):
+        load_repro_bundle(path)
+
+
+# ------------------------------------------------------------ normalizer
+
+
+@smoke
+def test_norm_stream_records_drops_wallclock_only(tmp_path):
+    """The one-definition normalizer (conftest's `norm_stream` fixture
+    delegates here): wall-clock fields, per-line crcs, the header tag,
+    and step_time seconds are ignored; everything else must survive."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    lines = [
+        {"event": "stream_header", "tag": "run-a", "schema": 3},
+        {"series": "loss", "value": 1.5, "nloop": 0, "t": 1.0, "crc": "xx"},
+        {"series": "step_time", "value": {"seconds": 0.5, "steps": 4},
+         "t": 2.0},
+    ]
+    with open(a, "w") as f:
+        for d in lines:
+            f.write(json.dumps(d) + "\n")
+    lines[0]["tag"] = "run-b"
+    lines[1]["t"], lines[1]["crc"] = 9.0, "yy"
+    lines[2]["value"]["seconds"] = 77.0
+    with open(b, "w") as f:
+        for d in lines:
+            f.write(json.dumps(d) + "\n")
+    assert norm_stream_records(a) == norm_stream_records(b)
+    # a VALUE divergence is preserved, not normalized away
+    lines[1]["value"] = 2.5
+    with open(b, "w") as f:
+        for d in lines:
+            f.write(json.dumps(d) + "\n")
+    assert norm_stream_records(a) != norm_stream_records(b)
+
+
+# ------------------------------------------------------- tolerated aborts
+
+
+@smoke
+def test_injected_storage_error_classifier():
+    """The oracle tolerates exactly the shim's own loud failure — an
+    OSError with the injected marker and a storage errno — and nothing
+    else. A real disk error, a plain crash, or a marker-less OSError
+    must still count as a `run_completes` violation."""
+    import errno
+
+    from federated_pytorch_test_tpu.fault.chaos import (
+        _injected_storage_error,
+    )
+
+    yes = [
+        OSError(errno.EIO, "injected I/O error writing metrics stream"),
+        OSError(errno.EIO, "injected storage I/O error reading /x.npz"),
+        OSError(errno.ENOSPC, "injected ENOSPC writing checkpoint"),
+    ]
+    no = [
+        OSError(errno.EIO, "Input/output error"),  # a REAL disk failure
+        OSError(errno.ENOENT, "injected ... wrong errno"),
+        ValueError("injected I/O error"),  # not an OSError at all
+        RuntimeError("boom"),
+    ]
+    for e in yes:
+        assert _injected_storage_error(e), e
+    for e in no:
+        assert not _injected_storage_error(e), e

@@ -44,6 +44,7 @@ from federated_pytorch_test_tpu.fault import (
     verify_crc,
     verify_digest,
 )
+from federated_pytorch_test_tpu.fault.io import retry_delay, retry_schedule
 from federated_pytorch_test_tpu.fault.scrub import scrub_main
 
 smoke = pytest.mark.smoke
@@ -147,6 +148,37 @@ def test_retry_io_bounded_backoff():
         retry_io(boom, what="t", backoff_s=0.0)
     with pytest.raises(ValueError, match="attempts"):
         retry_io(lambda: None, what="t", attempts=0)
+
+
+@smoke
+def test_retry_jitter_deterministic_schedule():
+    """The seeded backoff jitter (fault/io.py retry_delay): the sleep
+    after attempt `a` of operation label `what` is a pure function of
+    (what, a) — replayable chaos runs wait identical schedules — while
+    still decorrelating DIFFERENT operations (no retry convoy when one
+    injected fault trips many I/O paths at once)."""
+    # unit-pinned: these exact seconds are the published schedule for
+    # the store's chunk-read label at the default backoff — a changed
+    # RNG fold or jitter law must show up here, not in flaky CI walls
+    pinned = [0.04917203210491001, 0.06603219602252655, 0.29041871410669723]
+    assert retry_schedule("client_store chunk read", 4) == pinned
+    # pure in (what, attempt): the same call yields the same seconds
+    assert retry_schedule("client_store chunk read", 4) == pinned
+    assert retry_delay("client_store chunk read", 1) == pinned[1]
+    # different labels decorrelate
+    other = retry_schedule("metrics stream write", 4)
+    assert other != pinned
+    # jittered exponential envelope: base * 2^a * [0.5, 1.5)
+    for a in range(6):
+        d = retry_delay("envelope check", a, backoff_s=0.05)
+        assert 0.5 * 0.05 * 2**a <= d < 1.5 * 0.05 * 2**a
+    # the cap bounds the pre-jitter term (so the jittered sleep stays
+    # within [0.5, 1.5) * cap no matter how late the attempt)
+    d = retry_delay("x", 30, backoff_s=0.05, cap_s=0.2)
+    assert 0.5 * 0.2 <= d < 1.5 * 0.2
+    # a schedule is one delay per RETRY (attempts - 1)
+    assert len(retry_schedule("x", 1)) == 0
+    assert len(retry_schedule("x", 5)) == 4
 
 
 # -------------------------------------------------------------- fault shim
